@@ -1,0 +1,68 @@
+"""keystone_tpu — a TPU-native large-scale ML pipeline framework.
+
+A from-scratch JAX/XLA/Pallas rebuild of the capabilities of KeystoneML
+(nkhuyu/keystone): typed dataflow pipelines of Transformer / Estimator /
+LabelEstimator nodes, a distributed block-coordinate least-squares solver
+layer, image / speech / text featurization ops, loaders, and evaluators —
+re-designed TPU-first:
+
+- data parallelism = arrays sharded over a ``jax.sharding.Mesh`` "data" axis
+  (the moral successor of Spark RDD partitions),
+- model/feature-block parallelism = sharding over a "model" axis with XLA
+  collectives over ICI (the successor of mlmatrix block coordinate descent),
+- all reductions are ``psum``-shaped (XLA inserts them from sharding specs),
+- heavy ops are jitted matmuls/convs on the MXU in bf16/f32.
+
+Package layout (mirrors SURVEY.md §2 of the reference analysis):
+
+- ``core``       pipeline DSL, pytree node helper, config, logging
+- ``parallel``   mesh construction, sharding helpers, distributed reductions
+- ``ops``        the node library (stats, linear solvers, images, nlp, ...)
+- ``loaders``    host-side data ingestion feeding sharded device arrays
+- ``evaluation`` multiclass / binary / mean-AP evaluators
+- ``models``     end-to-end applications (MNIST, CIFAR, VOC, ImageNet, TIMIT,
+                 Newsgroups, n-gram LM)
+"""
+
+from keystone_tpu.core.pipeline import (
+    Estimator,
+    bind,
+    FunctionNode,
+    LabelEstimator,
+    Pipeline,
+    Transformer,
+    transformer,
+    estimator,
+    label_estimator,
+)
+from keystone_tpu.core.treenode import treenode, static_field
+from keystone_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    create_mesh,
+    data_sharding,
+    replicated_sharding,
+    shard_batch,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Estimator",
+    "bind",
+    "FunctionNode",
+    "LabelEstimator",
+    "Pipeline",
+    "Transformer",
+    "transformer",
+    "estimator",
+    "label_estimator",
+    "treenode",
+    "static_field",
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "create_mesh",
+    "data_sharding",
+    "replicated_sharding",
+    "shard_batch",
+]

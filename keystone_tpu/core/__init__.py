@@ -1,0 +1,38 @@
+"""Core: pipeline DSL, pytree helper, config, logging."""
+
+from keystone_tpu.core.pipeline import (
+    BoundTransformer,
+    Cacher,
+    bind,
+    ChainedEstimator,
+    ChainedLabelEstimator,
+    Estimator,
+    FunctionNode,
+    Identity,
+    LabelEstimator,
+    Pipeline,
+    Transformer,
+    estimator,
+    label_estimator,
+    transformer,
+)
+from keystone_tpu.core.treenode import static_field, treenode
+
+__all__ = [
+    "BoundTransformer",
+    "Cacher",
+    "bind",
+    "ChainedEstimator",
+    "ChainedLabelEstimator",
+    "Estimator",
+    "FunctionNode",
+    "Identity",
+    "LabelEstimator",
+    "Pipeline",
+    "Transformer",
+    "estimator",
+    "label_estimator",
+    "transformer",
+    "static_field",
+    "treenode",
+]

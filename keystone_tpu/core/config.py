@@ -1,0 +1,101 @@
+"""Per-app dataclass configs with auto-generated CLI parsing.
+
+The reference parses per-app case-class configs with scopt ``OptionParser``
+(e.g. ``pipelines/images/mnist/MnistRandomFFT.scala:90-116``). Here a config
+is a plain dataclass; :func:`parse_config` derives an ``argparse`` parser
+from its fields (name, type, default, and ``help`` from field metadata), so
+every model entry point gets a CLI for free:
+
+    @dataclasses.dataclass
+    class MnistConfig:
+        train_location: str = arg(required=True, help="path to train csv")
+        num_ffts: int = arg(default=4)
+
+    conf = parse_config(MnistConfig, argv)
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Any, Sequence, TypeVar, get_args, get_origin
+
+_C = TypeVar("_C")
+
+_MISSING = dataclasses.MISSING
+
+
+def arg(
+    default: Any = _MISSING,
+    *,
+    required: bool = False,
+    help: str = "",
+    choices: Sequence[Any] | None = None,
+) -> Any:
+    """Declare a config field with CLI metadata (scopt ``opt`` equivalent)."""
+    metadata = {"help": help, "required": required, "choices": choices}
+    if default is _MISSING and not required:
+        raise ValueError("config field needs a default unless required=True")
+    if default is _MISSING:
+        return dataclasses.field(default=None, metadata=metadata)
+    return dataclasses.field(default=default, metadata=metadata)
+
+
+def _parser_for(cls: type) -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog=cls.__name__, description=(cls.__doc__ or "").strip() or None
+    )
+    for f in dataclasses.fields(cls):
+        name = "--" + f.name.replace("_", "-")
+        meta = f.metadata or {}
+        ftype = f.type if isinstance(f.type, type) else _resolve_type(f.type)
+        kwargs: dict[str, Any] = {
+            "help": meta.get("help") or None,
+            "required": bool(meta.get("required")),
+            "dest": f.name,
+        }
+        if meta.get("choices"):
+            kwargs["choices"] = meta["choices"]
+        if f.default is _MISSING and f.default_factory is _MISSING:
+            # plain field without arg() and without a default: required
+            kwargs["required"] = True
+        if ftype is bool:
+            default = f.default if f.default not in (_MISSING, None) else False
+            parser.add_argument(
+                name,
+                action="store_false" if default else "store_true",
+                **{k: v for k, v in kwargs.items() if k != "choices"},
+            )
+            continue
+        if not kwargs["required"]:
+            kwargs["default"] = (
+                f.default_factory()
+                if f.default_factory is not _MISSING
+                else f.default
+            )
+        if ftype in (int, float, str):
+            kwargs["type"] = ftype
+        parser.add_argument(name, **kwargs)
+    return parser
+
+
+def _resolve_type(annotation: Any) -> type:
+    """Map string/Optional annotations to a concrete scalar type."""
+    if isinstance(annotation, str):
+        s = annotation.strip()
+        if s.startswith("Optional[") and s.endswith("]"):
+            s = s[len("Optional[") : -1]
+        s = s.split("|")[0].strip()  # "int | None" → "int"
+        return {"int": int, "float": float, "str": str, "bool": bool}.get(s, str)
+    origin = get_origin(annotation)
+    if origin is not None:  # Optional[int] etc.
+        for a in get_args(annotation):
+            if a is not type(None):
+                return _resolve_type(a)
+    return annotation if isinstance(annotation, type) else str
+
+
+def parse_config(cls: type[_C], argv: Sequence[str] | None = None) -> _C:
+    """Parse ``argv`` into an instance of the config dataclass ``cls``."""
+    ns = _parser_for(cls).parse_args(argv)
+    return cls(**vars(ns))

@@ -1,0 +1,42 @@
+"""Logging — successor of the reference's ``pipelines/Logging.scala:160-219``.
+
+The reference's log4j config (``src/main/resources/log4j.properties``) sets
+root=ERROR with INFO for pipeline/node/util loggers; we mirror that: the
+``keystone_tpu`` logger hierarchy defaults to INFO, everything else is left
+to the application. The Scala trait's ``@transient`` logger trick (so
+closures serialize) has no analog — pytree nodes never capture loggers.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import time
+from contextlib import contextmanager
+
+_CONFIGURED = False
+
+
+def get_logger(name: str = "keystone_tpu") -> logging.Logger:
+    global _CONFIGURED
+    if not _CONFIGURED:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
+        )
+        root = logging.getLogger("keystone_tpu")
+        root.addHandler(handler)
+        root.setLevel(logging.INFO)
+        root.propagate = False
+        _CONFIGURED = True
+    return logging.getLogger(name)
+
+
+@contextmanager
+def log_time(label: str, logger: logging.Logger | None = None):
+    """Wall-clock bracket, the reference's ``System.nanoTime`` idiom
+    (``MnistRandomFFT.scala:34,86-87``)."""
+    logger = logger or get_logger()
+    t0 = time.perf_counter()
+    yield
+    logger.info("%s took %.3fs", label, time.perf_counter() - t0)

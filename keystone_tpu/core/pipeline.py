@@ -1,0 +1,364 @@
+"""The pipeline DSL — the core abstraction of the framework.
+
+TPU-native re-design of KeystoneML's four-role pipeline algebra
+(reference: ``src/main/scala/pipelines/Transformer.scala:16-82``,
+``Estimator.scala:94-115``, ``LabelEstimator.scala:128-152``,
+``FunctionNode.scala:3``):
+
+- :class:`Transformer` — a pure function over a *batch*. In the reference a
+  Transformer maps one item and ``apply(RDD)`` defaults to ``in.map(apply)``
+  (``Transformer.scala:22``), with hot nodes overriding the RDD path to pack
+  partition rows into a matrix for one BLAS gemm. On TPU that batching idiom
+  *is* the default: ``__call__`` takes the whole (sharded) batch array, and
+  XLA maps it onto the MXU. Single-item application is batch-of-1.
+- :class:`Estimator` — ``fit(data) -> Transformer``.
+- :class:`LabelEstimator` — ``fit(data, labels) -> Transformer``.
+- :class:`FunctionNode` — escape hatch for whole-dataset operations that
+  aren't item-wise (the reference uses it for RDD→Seq[RDD] splits etc.,
+  ``FunctionNode.scala:3``).
+
+Composition: ``a.then(b)`` (or ``a >> b``) builds a :class:`Pipeline`
+(reference ``Transformer.scala:52-59``); chaining onto an estimator yields a
+:class:`ChainedEstimator` whose ``fit`` featurizes with the prefix first
+(reference ``thenEstimator``/``thenLabelEstimator``, ``Transformer.scala:37-50``).
+
+Unlike the reference there is a real jit boundary: every fitted node is a
+pytree (see :mod:`keystone_tpu.core.treenode`), so a whole fitted pipeline
+can be passed through ``jax.jit`` — the XLA graph is the execution plan where
+Spark's lazy RDD DAG used to be.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+
+from keystone_tpu.core.treenode import static_field, treenode
+
+
+class _Chainable:
+    """Mixin providing ``then`` / ``>>`` composition dispatch."""
+
+    def then(self, nxt):
+        """Compose this node with the next pipeline stage.
+
+        Dispatches on the type of ``nxt``:
+        - Transformer/Pipeline → :class:`Pipeline`
+        - Estimator → :class:`ChainedEstimator`
+        - LabelEstimator → :class:`ChainedLabelEstimator`
+        - bare callable → lifted via :func:`transformer`
+        """
+        if isinstance(nxt, LabelEstimator):
+            return ChainedLabelEstimator(prefix=_as_transformer(self), est=nxt)
+        if isinstance(nxt, Estimator):
+            return ChainedEstimator(prefix=_as_transformer(self), est=nxt)
+        if isinstance(nxt, Transformer):
+            return Pipeline.of(_as_transformer(self), nxt)
+        if callable(nxt):
+            return Pipeline.of(_as_transformer(self), transformer(nxt))
+        raise TypeError(f"cannot chain {type(nxt).__name__} onto a pipeline")
+
+    def __rshift__(self, nxt):
+        return self.then(nxt)
+
+
+class Transformer(_Chainable):
+    """A pure, deterministic function over a batch of items.
+
+    Subclasses implement :meth:`__call__` over a whole batch (leading axis =
+    items; for jnp arrays the batch may be sharded over the mesh "data" axis).
+    """
+
+    def __call__(self, batch):
+        raise NotImplementedError
+
+    # Alias matching the reference's `apply`.
+    def apply(self, batch):
+        return self(batch)
+
+    def apply_one(self, item):
+        """Single-item application = batch-of-1 (reference Transformer.scala:57)."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        if isinstance(item, (jax.Array, np.ndarray)):
+            return self(jnp.asarray(item)[None])[0]
+        out = self([item])
+        return out[0]
+
+    def jitted(self) -> Callable[[Any], Any]:
+        """A jit-compiled version of this (fitted) transformer.
+
+        The node travels as a pytree argument, so for treenode-style nodes
+        (arrays as pytree leaves) re-fitting with new weights reuses the
+        compiled executable. Note this does NOT hold for closures lifted with
+        :func:`transformer` that capture arrays — the closure is static
+        metadata, so each new closure recompiles; use :func:`bind` for
+        weight-carrying lifted nodes.
+        """
+        fn = jax.jit(lambda node, batch: node(batch))
+        return lambda batch: fn(self, batch)
+
+
+@treenode
+class FnTransformer(Transformer):
+    """A Transformer lifted from a bare batch function.
+
+    Reference: the companion ``Transformer(f)`` lift — but the lifted
+    function here takes the *batch*, matching the TPU-native batched
+    execution model.
+
+    The function is static pytree metadata: use this for *stateless* ops. If
+    the function closes over fitted arrays, each refit creates a distinct
+    static value and recompiles under jit — use :func:`bind` (params travel
+    as pytree leaves) or a dedicated ``@treenode`` class instead.
+    """
+
+    fn: Callable[[Any], Any] = static_field()
+    name: str = static_field(default="fn")
+
+    def __call__(self, batch):
+        return self.fn(batch)
+
+    def __repr__(self):
+        return f"FnTransformer({self.name})"
+
+
+def transformer(fn: Callable[[Any], Any], name: str | None = None) -> Transformer:
+    """Lift a batch function into a :class:`Transformer`."""
+    if isinstance(fn, Transformer):
+        return fn
+    return FnTransformer(fn=fn, name=name or getattr(fn, "__name__", "fn"))
+
+
+@treenode
+class BoundTransformer(Transformer):
+    """A lifted ``fn(params, batch)`` whose params are pytree leaves.
+
+    The jit-friendly way to lift a fitted closure: ``params`` (arrays) travel
+    as pytree children, ``fn`` stays static, so refits with new params hit
+    the same compiled executable.
+    """
+
+    params: Any
+    fn: Callable[[Any, Any], Any] = static_field()
+    name: str = static_field(default="bound")
+
+    def __call__(self, batch):
+        return self.fn(self.params, batch)
+
+    def __repr__(self):
+        return f"BoundTransformer({self.name})"
+
+
+def bind(
+    fn: Callable[[Any, Any], Any], params: Any, name: str | None = None
+) -> Transformer:
+    """Lift ``fn(params, batch)`` with ``params`` as pytree leaves."""
+    return BoundTransformer(
+        params=params, fn=fn, name=name or getattr(fn, "__name__", "bound")
+    )
+
+
+@treenode
+class Pipeline(Transformer):
+    """A chain of transformers applied in sequence (``then`` composition).
+
+    Flat tuple of nodes; nested pipelines are spliced in so ``repr`` and
+    indexing see the full chain (reference chains are nested closures,
+    ``Transformer.scala:52-59`` — flat is friendlier to jit and inspection).
+    """
+
+    nodes: tuple = ()
+
+    @staticmethod
+    def of(*nodes) -> "Pipeline":
+        flat: list[Transformer] = []
+        for n in nodes:
+            if isinstance(n, Pipeline):
+                flat.extend(n.nodes)
+            elif isinstance(n, Transformer):
+                flat.append(n)
+            elif callable(n):
+                flat.append(transformer(n))
+            else:
+                raise TypeError(f"not a pipeline node: {n!r}")
+        return Pipeline(nodes=tuple(flat))
+
+    def __call__(self, batch):
+        for node in self.nodes:
+            batch = node(batch)
+        return batch
+
+    def __iter__(self):
+        return iter(self.nodes)
+
+    def __len__(self):
+        return len(self.nodes)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return Pipeline(nodes=self.nodes[i])
+        return self.nodes[i]
+
+    def __repr__(self):
+        inner = " >> ".join(type(n).__name__ for n in self.nodes)
+        return f"Pipeline({inner})"
+
+
+class Estimator:
+    """Unsupervised estimator: ``fit(data) -> Transformer``.
+
+    Reference: ``pipelines/Estimator.scala`` (trait ``Estimator[A,B]``).
+    ``est.then(t)`` defers composition: the fitted model is followed by ``t``.
+    """
+
+    def fit(self, data) -> Transformer:
+        raise NotImplementedError
+
+    def fit_pipeline(self, data) -> Pipeline:
+        """Fit and wrap the result as a single-node pipeline."""
+        return Pipeline.of(self.fit(data))
+
+    def then(self, nxt) -> "Estimator":
+        return _SuffixedEstimator(est=self, suffix=_as_transformer(nxt))
+
+    def __rshift__(self, nxt):
+        return self.then(nxt)
+
+
+class LabelEstimator:
+    """Supervised estimator: ``fit(data, labels) -> Transformer``.
+
+    Reference: ``pipelines/LabelEstimator.scala`` (trait
+    ``LabelEstimator[I,O,L]``).
+    """
+
+    def fit(self, data, labels) -> Transformer:
+        raise NotImplementedError
+
+    def then(self, nxt) -> "LabelEstimator":
+        return _SuffixedLabelEstimator(est=self, suffix=_as_transformer(nxt))
+
+    def __rshift__(self, nxt):
+        return self.then(nxt)
+
+
+@treenode
+class FnEstimator(Estimator):
+    fn: Callable[[Any], Transformer] = static_field()
+
+    def fit(self, data) -> Transformer:
+        return self.fn(data)
+
+
+@treenode
+class FnLabelEstimator(LabelEstimator):
+    fn: Callable[[Any, Any], Transformer] = static_field()
+
+    def fit(self, data, labels) -> Transformer:
+        return self.fn(data, labels)
+
+
+def estimator(fn: Callable[[Any], Transformer]) -> Estimator:
+    """Lift ``fit``-shaped function into an Estimator (Estimator.scala:112)."""
+    return FnEstimator(fn=fn)
+
+
+def label_estimator(fn: Callable[[Any, Any], Transformer]) -> LabelEstimator:
+    return FnLabelEstimator(fn=fn)
+
+
+@treenode
+class _SuffixedEstimator(Estimator):
+    """``estimator then transformer`` — fitted model followed by a suffix."""
+
+    est: Estimator
+    suffix: Transformer
+
+    def fit(self, data) -> Pipeline:
+        return Pipeline.of(self.est.fit(data), self.suffix)
+
+
+@treenode
+class _SuffixedLabelEstimator(LabelEstimator):
+    est: LabelEstimator
+    suffix: Transformer
+
+    def fit(self, data, labels) -> Pipeline:
+        return Pipeline.of(self.est.fit(data, labels), self.suffix)
+
+
+@treenode
+class ChainedEstimator(Estimator):
+    """``prefix then estimator`` — fit featurizes with the prefix first.
+
+    Reference: ``Transformer.thenEstimator`` (``Transformer.scala:37-43``).
+    """
+
+    prefix: Transformer
+    est: Estimator
+
+    def fit(self, data) -> Pipeline:
+        model = self.est.fit(self.prefix(data))
+        return Pipeline.of(self.prefix, model)
+
+
+@treenode
+class ChainedLabelEstimator(LabelEstimator):
+    """``prefix then labelEstimator`` (``Transformer.scala:45-50``)."""
+
+    prefix: Transformer
+    est: LabelEstimator
+
+    def fit(self, data, labels) -> Pipeline:
+        model = self.est.fit(self.prefix(data), labels)
+        return Pipeline.of(self.prefix, model)
+
+
+class FunctionNode(_Chainable):
+    """Whole-dataset operation that isn't item-wise (FunctionNode.scala:3).
+
+    Used where the reference maps an RDD to a *collection of* RDDs or an
+    array: VectorSplitter, Windower, ColumnSampler, ZipVectors, NGramsCounts.
+    Subclasses implement ``__call__`` over the dataset-level object.
+    """
+
+    def __call__(self, data):
+        raise NotImplementedError
+
+
+def _as_transformer(node) -> Transformer:
+    if isinstance(node, Transformer):
+        return node
+    if isinstance(node, FunctionNode):
+        return transformer(node, name=type(node).__name__)
+    if callable(node):
+        return transformer(node)
+    raise TypeError(f"not a transformer: {node!r}")
+
+
+@treenode
+class Identity(Transformer):
+    """No-op transformer (reference nodes/util/Identity.scala:135-137)."""
+
+    def __call__(self, batch):
+        return batch
+
+
+@treenode
+class Cacher(Transformer):
+    """Materialization point (reference ``nodes/util/Cacher.scala``).
+
+    Spark's ``.cache()`` becomes: force the lazy array computation to
+    complete and keep the result resident in device memory. Only meaningful
+    in *eager* pipeline execution — under ``jax.jit`` tracing,
+    ``block_until_ready`` is a no-op on tracers and XLA fuses straight
+    through this node.
+    """
+
+    name: str = static_field(default="")
+
+    def __call__(self, batch):
+        return jax.block_until_ready(batch)

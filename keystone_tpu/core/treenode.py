@@ -1,0 +1,55 @@
+"""Pytree-dataclass helper.
+
+Every fitted pipeline node in keystone_tpu is a dataclass registered as a JAX
+pytree: array-valued fields are pytree leaves (so fitted pipelines can be
+jitted through, vmapped, donated, and checkpointed with orbax), while
+configuration fields are static metadata (so they participate in jit cache
+keys, not tracing).
+
+This replaces the reference's Scala ``Serializable`` closures (KeystoneML
+ships nodes to Spark executors by Java serialization; we ship them to TPU
+devices as pytrees of arrays).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, TypeVar
+
+import jax
+
+_T = TypeVar("_T")
+
+
+def static_field(**kwargs: Any) -> Any:
+    """A dataclass field treated as static pytree metadata (not a leaf).
+
+    Use for python-level config: ints, strings, shapes, callables — anything
+    that should be baked into the jit-compiled program rather than traced.
+    """
+    metadata = dict(kwargs.pop("metadata", {}) or {})
+    metadata["static"] = True
+    return dataclasses.field(metadata=metadata, **kwargs)
+
+
+def treenode(cls: type[_T] | None = None) -> Callable[[type[_T]], type[_T]] | type[_T]:
+    """Class decorator: dataclass + JAX pytree registration.
+
+    Fields created with :func:`static_field` become pytree metadata; all other
+    fields become children. Works as ``@treenode`` or ``@treenode()``.
+    """
+
+    def wrap(c: type[_T]) -> type[_T]:
+        if not dataclasses.is_dataclass(c):
+            c = dataclasses.dataclass(c)
+        fields = dataclasses.fields(c)
+        data_fields = [f.name for f in fields if not f.metadata.get("static")]
+        meta_fields = [f.name for f in fields if f.metadata.get("static")]
+        jax.tree_util.register_dataclass(
+            c, data_fields=data_fields, meta_fields=meta_fields
+        )
+        return c
+
+    if cls is None:
+        return wrap
+    return wrap(cls)

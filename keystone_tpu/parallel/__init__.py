@@ -1,0 +1,38 @@
+"""Parallelism: mesh construction, sharding specs, distributed reductions.
+
+This package is the TPU-native successor of two reference subsystems at once
+(SURVEY.md §2.11):
+
+- Spark's execution substrate (RDD partitions, treeAggregate/treeReduce,
+  broadcast, shuffle) → a ``jax.sharding.Mesh`` with a "data" axis, XLA
+  collectives over ICI/DCN, and replication-by-sharding-spec.
+- the ``mlmatrix`` distributed linear-algebra jar (RowPartitionedMatrix,
+  NormalEquations, BlockCoordinateDescent) → sharded normal-equation
+  reductions in :mod:`keystone_tpu.ops.linear`.
+"""
+
+from keystone_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    create_mesh,
+    current_mesh,
+    data_sharding,
+    model_sharding,
+    pad_batch,
+    replicated_sharding,
+    shard_batch,
+    use_mesh,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "create_mesh",
+    "current_mesh",
+    "data_sharding",
+    "model_sharding",
+    "pad_batch",
+    "replicated_sharding",
+    "shard_batch",
+    "use_mesh",
+]

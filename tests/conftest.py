@@ -1,0 +1,59 @@
+"""Test harness: an 8-device virtual CPU mesh.
+
+The reference simulates clusters with local-mode Spark + multi-partition RDDs
+(``src/test/scala/pipelines/LocalSparkContext.scala``, SURVEY.md §4.1). The
+TPU-native equivalent: force the JAX CPU backend to expose 8 host devices so
+every sharding/collective path is exercised by the unit tests exactly as it
+would run on an 8-chip slice.
+
+Must run before jax initializes a backend — conftest import time is safe as
+long as no other conftest/plugin imports jax first.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+# The sandbox's sitecustomize may already have imported jax with the TPU
+# platform selected; backend init is lazy, so overriding the config here
+# (before any jax.devices() call) still lands us on the 8-device CPU mesh.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture
+def mesh8(devices):
+    """8-way data-parallel mesh — the `local[4]`-with-partitions analog."""
+    from keystone_tpu.parallel.mesh import create_mesh
+
+    return create_mesh(data=8)
+
+
+@pytest.fixture
+def mesh4x2(devices):
+    """4-way data x 2-way model mesh for block/model-parallel tests."""
+    from keystone_tpu.parallel.mesh import create_mesh
+
+    return create_mesh(data=4, model=2)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,187 @@
+"""Pipeline DSL tests (reference suites: pipelines/*Suite.scala)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu import (
+    Estimator,
+    LabelEstimator,
+    Pipeline,
+    Transformer,
+    transformer,
+)
+from keystone_tpu.core.pipeline import Cacher, Identity
+from keystone_tpu.core.treenode import static_field, treenode
+from keystone_tpu.parallel.mesh import create_mesh, shard_batch
+
+
+@treenode
+class Scale(Transformer):
+    factor: jnp.ndarray
+
+    def __call__(self, batch):
+        return batch * self.factor
+
+
+@treenode
+class MeanCenterEstimator(Estimator):
+    def fit(self, data):
+        mu = jnp.mean(data, axis=0)
+        return transformer(lambda b, mu=mu: b - mu, name="center")
+
+
+class ScaleToLabelMean(LabelEstimator):
+    def fit(self, data, labels):
+        return Scale(factor=jnp.mean(labels) / jnp.mean(data))
+
+
+def test_then_composition_applies_in_order():
+    p = transformer(lambda b: b + 1.0) >> transformer(lambda b: b * 2.0)
+    out = p(jnp.zeros((4, 3)))
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+
+
+def test_pipeline_flattens_nested():
+    a = transformer(lambda b: b + 1)
+    p = (a >> a) >> (a >> a)
+    assert isinstance(p, Pipeline) and len(p) == 4
+
+
+def test_apply_one_is_batch_of_one():
+    s = Scale(factor=jnp.asarray(3.0))
+    out = s.apply_one(jnp.ones((5,)))
+    assert out.shape == (5,)
+    np.testing.assert_allclose(np.asarray(out), 3.0)
+
+
+def test_then_estimator_fits_on_transformed_data():
+    data = jnp.arange(12.0).reshape(6, 2)
+    chained = transformer(lambda b: b * 2) >> MeanCenterEstimator()
+    fitted = chained.fit(data)
+    assert isinstance(fitted, Pipeline)
+    out = fitted(data)
+    np.testing.assert_allclose(np.asarray(jnp.mean(out, axis=0)), 0.0, atol=1e-6)
+
+
+def test_then_label_estimator():
+    data = jnp.ones((4, 2))
+    labels = jnp.full((4,), 6.0)
+    fitted = (transformer(lambda b: b * 2) >> ScaleToLabelMean()).fit(data, labels)
+    out = fitted(data)
+    np.testing.assert_allclose(np.asarray(out), 6.0)
+
+
+def test_fitted_pipeline_is_jittable_pytree():
+    p = Scale(factor=jnp.asarray(2.0)) >> transformer(lambda b: b + 1)
+    jit_apply = jax.jit(lambda node, x: node(x))
+    out = jit_apply(p, jnp.ones((8, 4)))
+    np.testing.assert_allclose(np.asarray(out), 3.0)
+    # new weights, same compiled executable
+    p2 = Scale(factor=jnp.asarray(5.0)) >> transformer(lambda b: b + 1)
+    out2 = jit_apply(p2, jnp.ones((8, 4)))
+    np.testing.assert_allclose(np.asarray(out2), 6.0)
+
+
+def test_jitted_helper():
+    s = Scale(factor=jnp.asarray(2.0))
+    f = s.jitted()
+    np.testing.assert_allclose(np.asarray(f(jnp.ones((2, 2)))), 2.0)
+
+
+def test_identity_and_cacher_are_noops():
+    x = jnp.arange(6.0).reshape(2, 3)
+    np.testing.assert_array_equal(np.asarray(Identity()(x)), np.asarray(x))
+    np.testing.assert_array_equal(np.asarray(Cacher(name="x")(x)), np.asarray(x))
+
+
+def test_sharded_batch_flows_through_pipeline(mesh8):
+    x = np.ones((16, 4), np.float32)
+    xs = shard_batch(x, mesh8)
+    assert len(xs.sharding.device_set) == 8
+    p = Scale(factor=jnp.asarray(2.0)) >> transformer(lambda b: b - 1.0)
+    out = jax.jit(lambda node, b: node(b))(p, xs)
+    np.testing.assert_allclose(np.asarray(out), 1.0)
+
+
+def test_pad_and_shard_uneven_batch(mesh8):
+    x = np.ones((10, 3), np.float32)
+    xs = shard_batch(x, mesh8)
+    assert xs.shape == (16, 3)  # padded to multiple of 8
+    np.testing.assert_allclose(np.asarray(xs)[:10], 1.0)
+    np.testing.assert_allclose(np.asarray(xs)[10:], 0.0)
+
+
+def test_mesh_shapes(mesh4x2):
+    assert mesh4x2.shape == {"data": 4, "model": 2}
+
+
+def test_chain_type_errors():
+    with pytest.raises(TypeError):
+        transformer(lambda b: b).then(123)
+
+
+def test_estimator_then_transformer_suffix():
+    """est.then(t): fitted model followed by suffix (code-review regression)."""
+    data = jnp.arange(12.0).reshape(6, 2)
+    est = MeanCenterEstimator() >> transformer(lambda b: b * 10)
+    fitted = est.fit(data)
+    out = fitted(data)
+    np.testing.assert_allclose(np.asarray(jnp.mean(out, axis=0)), 0.0, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray((data - data.mean(0)) * 10), atol=1e-5
+    )
+
+
+def test_bind_refit_reuses_compiled_executable():
+    """bind() carries params as leaves -> no recompile on refit."""
+    from keystone_tpu.core.pipeline import bind
+
+    def sub(mu, b):
+        return b - mu
+
+    f = jax.jit(lambda node, x: node(x))
+    t1 = bind(sub, jnp.asarray(1.0))
+    t2 = bind(sub, jnp.asarray(5.0))
+    x = jnp.zeros((4, 2))
+    np.testing.assert_allclose(np.asarray(f(t1, x)), -1.0)
+    misses_before = f._cache_size()
+    np.testing.assert_allclose(np.asarray(f(t2, x)), -5.0)
+    assert f._cache_size() == misses_before  # same executable
+
+
+def test_config_plain_field_is_required_and_optional_int_parses():
+    import dataclasses
+
+    import pytest as _pytest
+
+    from keystone_tpu.core.config import arg, parse_config
+
+    @dataclasses.dataclass
+    class Conf:
+        x: int
+        n: "int | None" = arg(default=3)
+        frac: "Optional[float]" = arg(default=0.5)
+
+    c = parse_config(Conf, ["--x", "2", "--n", "7", "--frac", "0.25"])
+    assert c.x == 2 and c.n == 7 and abs(c.frac - 0.25) < 1e-9
+    assert isinstance(c.n, int) and isinstance(c.frac, float)
+    with _pytest.raises(SystemExit):
+        parse_config(Conf, [])  # x is required
+
+
+def test_config_required_bool_enforced():
+    import dataclasses
+
+    import pytest as _pytest
+
+    from keystone_tpu.core.config import arg, parse_config
+
+    @dataclasses.dataclass
+    class Conf:
+        flag: bool = arg(required=True)
+
+    assert parse_config(Conf, ["--flag"]).flag is True
+    with _pytest.raises(SystemExit):
+        parse_config(Conf, [])
