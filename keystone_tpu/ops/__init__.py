@@ -1,0 +1,15 @@
+"""The node library — Transformers/Estimators over batched arrays.
+
+TPU-native successor of the reference's ``src/main/scala/nodes/`` tree
+(SURVEY.md §2.2-§2.6): every node is a pytree, operates on whole (possibly
+mesh-sharded) batches, and is jit-composable. Submodules:
+
+- ``stats``   scalers, random features, FFT, rectifiers, normalizers
+- ``util``    label indicators, classifiers, casts, block split/zip
+- ``linear``  linear models and the distributed least-squares solver layer
+- ``linalg``  PCA / ZCA / LDA
+- ``images``  convolution / pooling / windowing / rectification / descriptors
+- ``gmm``     Gaussian mixture EM + Fisher vectors
+- ``nlp``     tokenization, n-grams, language models (host+device split)
+- ``sparse``  sparse-feature capping and dense-ification
+"""

@@ -1,0 +1,122 @@
+"""Utility nodes (reference ``nodes/util/``, SURVEY.md §2.5)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.core.pipeline import FunctionNode, Transformer
+from keystone_tpu.core.treenode import static_field, treenode
+
+
+@treenode
+class ClassLabelIndicators(Transformer):
+    """Int label(s) → ±1 indicator vector (nodes/util/ClassLabelIndicators.scala).
+
+    Accepts an (N,) int batch (one label per item) or an (N, k) / ragged list
+    batch of multi-labels; output is (N, num_classes) with +1 at label
+    positions and −1 elsewhere.
+    """
+
+    num_classes: int = static_field(default=2)
+
+    def __call__(self, batch):
+        if isinstance(batch, (list, tuple)):  # ragged multi-label
+            out = -np.ones((len(batch), self.num_classes), np.float32)
+            for i, labels in enumerate(batch):
+                out[i, np.asarray(labels, np.int32)] = 1.0
+            return jnp.asarray(out)
+        batch = jnp.asarray(batch)
+        if batch.ndim == 1:
+            onehot = jnp.zeros(
+                (batch.shape[0], self.num_classes), jnp.float32
+            ).at[jnp.arange(batch.shape[0]), batch].set(1.0)
+        else:  # (N, k) padded multi-label, negative entries = padding
+            valid = batch >= 0
+            clipped = jnp.clip(batch, 0, self.num_classes - 1)
+            onehot = jnp.zeros((batch.shape[0], self.num_classes), jnp.float32)
+            onehot = onehot.at[
+                jnp.arange(batch.shape[0])[:, None], clipped
+            ].max(valid.astype(jnp.float32))
+        return 2.0 * onehot - 1.0
+
+
+@treenode
+class MaxClassifier(Transformer):
+    """Argmax over the feature axis (nodes/util/MaxClassifier.scala)."""
+
+    def __call__(self, batch):
+        return jnp.argmax(batch, axis=-1)
+
+
+@treenode
+class TopKClassifier(Transformer):
+    """Top-k indices, highest score first (nodes/util/TopKClassifier.scala)."""
+
+    k: int = static_field(default=5)
+
+    def __call__(self, batch):
+        _, idx = jax.lax.top_k(batch, self.k)
+        return idx
+
+
+@treenode
+class Cast(Transformer):
+    """Dtype conversion. Covers the reference's ``FloatToDouble``; on TPU the
+    useful casts are f32↔bf16 (nodes/util/FloatToDouble.scala)."""
+
+    dtype: str = static_field(default="float32")
+
+    def __call__(self, batch):
+        return jnp.asarray(batch).astype(self.dtype)
+
+
+def FloatToDouble() -> Cast:
+    """Reference-parity alias. TPUs have no fast f64; the solver layer works
+    in f32, so this is a no-op-ish cast kept for pipeline parity."""
+    return Cast(dtype="float32")
+
+
+@treenode
+class MatrixVectorizer(Transformer):
+    """Flatten per-item matrices to vectors (nodes/util/MatrixVectorizer.scala).
+
+    Input (N, a, b) → output (N, a*b), column-major to match the reference's
+    Breeze ``toDenseVector`` flattening.
+    """
+
+    def __call__(self, batch):
+        n = batch.shape[0]
+        return jnp.transpose(batch, (0, 2, 1)).reshape(n, -1)
+
+
+@treenode
+class VectorSplitter(FunctionNode):
+    """Split (N, D) features into column blocks — the feature-blocking
+    primitive feeding the block solvers (nodes/util/VectorSplitter.scala).
+
+    The last block may be narrower, matching the reference. On a mesh this is
+    pure slicing of the (replicated-feature-axis) array; the block solvers
+    iterate blocks as the reference's BCD does.
+    """
+
+    block_size: int = static_field(default=4096)
+    num_features: int | None = static_field(default=None)
+
+    def __call__(self, data) -> list:
+        d = self.num_features or data.shape[-1]
+        return [
+            data[..., start : min(start + self.block_size, d)]
+            for start in range(0, d, self.block_size)
+        ]
+
+
+@treenode
+class ZipVectors(FunctionNode):
+    """Concatenate a list of (N, d_i) feature families along the feature axis
+    (nodes/util/ZipVectors.scala). Identically data-sharded arrays concat
+    shard-locally — the 'zip of co-partitioned RDDs' pattern is free here."""
+
+    def __call__(self, datasets) -> jnp.ndarray:
+        return jnp.concatenate(list(datasets), axis=-1)

@@ -1,0 +1,182 @@
+"""Stats/util node tests (reference: nodes/stats/*Suite, nodes/util/*Suite)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.ops.stats import (
+    ColumnSampler,
+    CosineRandomFeatures,
+    LinearRectifier,
+    NormalizeRows,
+    PaddedFFT,
+    RandomSignNode,
+    Sampler,
+    SignedHellingerMapper,
+    StandardScaler,
+    TermFrequency,
+)
+from keystone_tpu.ops.util import (
+    Cast,
+    ClassLabelIndicators,
+    MatrixVectorizer,
+    MaxClassifier,
+    TopKClassifier,
+    VectorSplitter,
+    ZipVectors,
+)
+from keystone_tpu.parallel.mesh import shard_batch
+
+
+def test_standard_scaler_moments(rng):
+    x = rng.normal(loc=3.0, scale=2.0, size=(500, 4)).astype(np.float32)
+    model = StandardScaler().fit(jnp.asarray(x))
+    out = np.asarray(model(jnp.asarray(x)))
+    np.testing.assert_allclose(out.mean(0), 0.0, atol=1e-5)
+    np.testing.assert_allclose(out.std(0, ddof=1), 1.0, atol=1e-3)
+
+
+def test_standard_scaler_no_std(rng):
+    x = rng.normal(size=(50, 3)).astype(np.float32)
+    model = StandardScaler(normalize_std_dev=False).fit(jnp.asarray(x))
+    assert model.std is None
+    out = np.asarray(model(jnp.asarray(x)))
+    np.testing.assert_allclose(out.mean(0), 0.0, atol=1e-6)
+    np.testing.assert_allclose(out.std(0), x.std(0), rtol=1e-5)
+
+
+def test_standard_scaler_masks_padding(rng, mesh8):
+    x = rng.normal(loc=5.0, size=(10, 3)).astype(np.float32)
+    xs = shard_batch(x, mesh8)  # pads to 16 with zeros
+    model = StandardScaler().fit(xs, n_valid=10)
+    np.testing.assert_allclose(np.asarray(model.mean), x.mean(0), atol=1e-5)
+    ref_std = x.std(0, ddof=1)
+    np.testing.assert_allclose(np.asarray(model.std), ref_std, rtol=1e-4)
+
+
+def test_standard_scaler_constant_column_guard():
+    x = jnp.ones((8, 2))
+    model = StandardScaler().fit(x)
+    out = np.asarray(model(x))
+    assert np.isfinite(out).all()
+    np.testing.assert_allclose(out, 0.0)
+
+
+def test_random_sign_node_is_involution():
+    node = RandomSignNode.create(16, jax.random.key(0))
+    signs = np.asarray(node.signs)
+    assert set(np.unique(signs)) <= {-1.0, 1.0}
+    x = jnp.arange(32.0).reshape(2, 16)
+    np.testing.assert_allclose(np.asarray(node(node(x))), np.asarray(x))
+
+
+def test_padded_fft_matches_numpy(rng):
+    x = rng.normal(size=(3, 50)).astype(np.float32)
+    out = np.asarray(PaddedFFT()(jnp.asarray(x)))
+    assert out.shape == (3, 32)  # next pow2 = 64, half = 32
+    ref = np.real(np.fft.fft(np.pad(x, [(0, 0), (0, 14)]), axis=-1))[:, :32]
+    np.testing.assert_allclose(out, ref, atol=1e-3)
+
+
+def test_linear_rectifier():
+    x = jnp.asarray([[-2.0, 0.5, 3.0]])
+    out = np.asarray(LinearRectifier(max_val=0.0, alpha=1.0)(x))
+    np.testing.assert_allclose(out, [[0.0, 0.0, 2.0]])
+
+
+def test_cosine_random_features_shape_and_range(rng):
+    node = CosineRandomFeatures.create(8, 32, jax.random.key(1), gamma=0.5)
+    x = jnp.asarray(rng.normal(size=(5, 8)).astype(np.float32))
+    out = np.asarray(node(x))
+    assert out.shape == (5, 32)
+    assert (out >= -1).all() and (out <= 1).all()
+    # cauchy variant
+    node_c = CosineRandomFeatures.create(
+        8, 16, jax.random.key(2), distribution="cauchy"
+    )
+    assert np.asarray(node_c(x)).shape == (5, 16)
+
+
+def test_normalize_rows(rng):
+    x = rng.normal(size=(4, 6)).astype(np.float32)
+    out = np.asarray(NormalizeRows()(jnp.asarray(x)))
+    np.testing.assert_allclose(np.linalg.norm(out, axis=1), 1.0, rtol=1e-5)
+    # zero row stays finite
+    z = np.asarray(NormalizeRows()(jnp.zeros((1, 3))))
+    assert np.isfinite(z).all()
+
+
+def test_signed_hellinger():
+    x = jnp.asarray([[-4.0, 9.0, 0.0]])
+    np.testing.assert_allclose(
+        np.asarray(SignedHellingerMapper()(x)), [[-2.0, 3.0, 0.0]]
+    )
+
+
+def test_class_label_indicators_int():
+    out = np.asarray(ClassLabelIndicators(num_classes=4)(jnp.asarray([0, 3])))
+    np.testing.assert_array_equal(
+        out, [[1, -1, -1, -1], [-1, -1, -1, 1]]
+    )
+
+
+def test_class_label_indicators_multilabel_ragged_and_padded():
+    ragged = ClassLabelIndicators(num_classes=4)([[0, 2], [1]])
+    np.testing.assert_array_equal(
+        np.asarray(ragged), [[1, -1, 1, -1], [-1, 1, -1, -1]]
+    )
+    padded = ClassLabelIndicators(num_classes=4)(jnp.asarray([[0, 2], [1, -1]]))
+    np.testing.assert_array_equal(np.asarray(padded), np.asarray(ragged))
+
+
+def test_max_and_topk_classifier():
+    scores = jnp.asarray([[0.1, 0.9, 0.3], [0.8, 0.2, 0.5]])
+    np.testing.assert_array_equal(np.asarray(MaxClassifier()(scores)), [1, 0])
+    topk = np.asarray(TopKClassifier(k=2)(scores))
+    np.testing.assert_array_equal(topk, [[1, 2], [0, 2]])
+
+
+def test_matrix_vectorizer_column_major():
+    m = jnp.asarray([[[1.0, 2.0], [3.0, 4.0]]])  # (1, 2, 2)
+    out = np.asarray(MatrixVectorizer()(m))
+    np.testing.assert_array_equal(out, [[1.0, 3.0, 2.0, 4.0]])
+
+
+def test_vector_splitter_and_zip_roundtrip(rng):
+    x = jnp.asarray(rng.normal(size=(6, 10)).astype(np.float32))
+    blocks = VectorSplitter(block_size=4)(x)
+    assert [b.shape[-1] for b in blocks] == [4, 4, 2]
+    back = ZipVectors()(blocks)
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(x))
+
+
+def test_cast():
+    x = jnp.zeros((2, 2), jnp.float32)
+    assert Cast(dtype="bfloat16")(x).dtype == jnp.bfloat16
+
+
+def test_sampler_and_column_sampler(rng):
+    x = jnp.asarray(rng.normal(size=(100, 3)).astype(np.float32))
+    assert Sampler(size=10)(x).shape == (10, 3)
+    assert Sampler(size=200)(x).shape == (100, 3)
+    mats = [rng.normal(size=(5, 7)).astype(np.float32) for _ in range(3)]
+    cols = ColumnSampler(num_cols=12)(mats)
+    assert cols.shape == (12, 5)
+
+
+def test_term_frequency():
+    out = TermFrequency(fn=lambda c: c * c)([["a", "b", "a"], ["c"]])
+    assert out == [{"a": 4, "b": 1}, {"c": 1}]
+
+
+def test_fft_pipeline_composes_with_jit(mesh8, rng):
+    """MNIST featurizer shape: sign -> fft -> relu, jitted on sharded batch."""
+    x = shard_batch(rng.normal(size=(16, 50)).astype(np.float32), mesh8)
+    feat = (
+        RandomSignNode.create(50, jax.random.key(0))
+        >> PaddedFFT()
+        >> LinearRectifier()
+    )
+    out = jax.jit(lambda p, b: p(b))(feat, x)
+    assert out.shape == (16, 32)
+    assert (np.asarray(out) >= 0).all()
