@@ -1,0 +1,19 @@
+"""Evaluators (reference ``src/main/scala/evaluation/``, SURVEY.md §2.8)."""
+
+from keystone_tpu.evaluation.binary import (
+    BinaryClassificationMetrics,
+    BinaryClassifierEvaluator,
+)
+from keystone_tpu.evaluation.mean_ap import MeanAveragePrecisionEvaluator
+from keystone_tpu.evaluation.multiclass import (
+    MulticlassClassifierEvaluator,
+    MulticlassMetrics,
+)
+
+__all__ = [
+    "BinaryClassificationMetrics",
+    "BinaryClassifierEvaluator",
+    "MeanAveragePrecisionEvaluator",
+    "MulticlassClassifierEvaluator",
+    "MulticlassMetrics",
+]

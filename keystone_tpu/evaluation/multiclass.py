@@ -1,0 +1,142 @@
+"""Multiclass evaluation (reference evaluation/MulticlassClassifierEvaluator.scala).
+
+The reference builds the confusion matrix with a single-pass Spark
+``aggregate``; here it's a one-hot scatter-add over the sharded batch — the
+cross-device combine is XLA's psum. Metrics and the pretty printer mirror the
+reference's (Mahout-style) report.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnames=("num_classes",))
+def _confusion(predicted, actual, num_classes: int, n_valid=None):
+    """(num_classes, num_classes) matrix, rows = actual, cols = predicted."""
+    n = predicted.shape[0]
+    valid = (
+        jnp.ones((n,), jnp.float32)
+        if n_valid is None
+        else (jnp.arange(n) < n_valid).astype(jnp.float32)
+    )
+    flat = actual * num_classes + predicted
+    counts = jnp.zeros((num_classes * num_classes,), jnp.float32).at[flat].add(valid)
+    return counts.reshape(num_classes, num_classes)
+
+
+@dataclasses.dataclass
+class MulticlassMetrics:
+    confusion: np.ndarray  # rows = actual class, cols = predicted class
+
+    @property
+    def num_classes(self) -> int:
+        return self.confusion.shape[0]
+
+    @property
+    def total(self) -> float:
+        return float(self.confusion.sum())
+
+    @property
+    def accuracy(self) -> float:
+        return float(np.trace(self.confusion) / max(self.confusion.sum(), 1))
+
+    @property
+    def error(self) -> float:
+        return 1.0 - self.accuracy
+
+    def class_precision(self) -> np.ndarray:
+        pred_totals = self.confusion.sum(axis=0)
+        return np.divide(
+            np.diag(self.confusion),
+            pred_totals,
+            out=np.zeros(self.num_classes),
+            where=pred_totals > 0,
+        )
+
+    def class_recall(self) -> np.ndarray:
+        actual_totals = self.confusion.sum(axis=1)
+        return np.divide(
+            np.diag(self.confusion),
+            actual_totals,
+            out=np.zeros(self.num_classes),
+            where=actual_totals > 0,
+        )
+
+    def class_f1(self) -> np.ndarray:
+        p, r = self.class_precision(), self.class_recall()
+        denom = np.where(p + r > 0, p + r, 1.0)
+        return np.where(p + r > 0, 2 * p * r / denom, 0.0)
+
+    @property
+    def macro_precision(self) -> float:
+        return float(self.class_precision().mean())
+
+    @property
+    def macro_recall(self) -> float:
+        return float(self.class_recall().mean())
+
+    @property
+    def macro_f1(self) -> float:
+        return float(self.class_f1().mean())
+
+    # Micro-averaged P/R/F all equal accuracy for single-label multiclass,
+    # as in the reference's MulticlassMetrics.
+    @property
+    def micro_precision(self) -> float:
+        return self.accuracy
+
+    @property
+    def micro_recall(self) -> float:
+        return self.accuracy
+
+    @property
+    def micro_f1(self) -> float:
+        return self.accuracy
+
+    def summary(self, class_names: list[str] | None = None) -> str:
+        names = class_names or [str(i) for i in range(self.num_classes)]
+        lines = [
+            "=" * 60,
+            "Summary",
+            "-" * 60,
+            f"Correctly Classified Instances   : {int(np.trace(self.confusion))}"
+            f"  ({100 * self.accuracy:.4f}%)",
+            f"Incorrectly Classified Instances : "
+            f"{int(self.total - np.trace(self.confusion))}"
+            f"  ({100 * self.error:.4f}%)",
+            f"Total Classified Instances       : {int(self.total)}",
+            f"Macro Precision/Recall/F1        : {self.macro_precision:.4f} / "
+            f"{self.macro_recall:.4f} / {self.macro_f1:.4f}",
+            "-" * 60,
+            "Confusion Matrix (rows=actual, cols=predicted)",
+        ]
+        width = max(6, max(len(n) for n in names) + 1)
+        header = " " * width + "".join(f"{n:>{width}}" for n in names)
+        lines.append(header)
+        for i, row in enumerate(self.confusion.astype(int)):
+            lines.append(
+                f"{names[i]:>{width}}" + "".join(f"{v:>{width}}" for v in row)
+            )
+        lines.append("=" * 60)
+        return "\n".join(lines)
+
+
+class MulticlassClassifierEvaluator:
+    """Evaluate predicted vs actual int labels → :class:`MulticlassMetrics`."""
+
+    def __init__(self, num_classes: int):
+        self.num_classes = num_classes
+
+    def evaluate(self, predicted, actual, n_valid: int | None = None):
+        predicted = jnp.asarray(predicted).astype(jnp.int32)
+        actual = jnp.asarray(actual).astype(jnp.int32)
+        conf = _confusion(predicted, actual, self.num_classes, n_valid)
+        return MulticlassMetrics(confusion=np.asarray(conf, dtype=np.float64))
+
+    __call__ = evaluate

@@ -1,0 +1,72 @@
+"""Evaluator tests (reference evaluation/*Suite)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.evaluation import (
+    BinaryClassifierEvaluator,
+    MeanAveragePrecisionEvaluator,
+    MulticlassClassifierEvaluator,
+)
+
+
+def test_multiclass_confusion_and_metrics():
+    actual = jnp.asarray([0, 0, 1, 1, 2, 2])
+    pred = jnp.asarray([0, 1, 1, 1, 2, 0])
+    m = MulticlassClassifierEvaluator(3)(pred, actual)
+    np.testing.assert_array_equal(
+        m.confusion, [[1, 1, 0], [0, 2, 0], [1, 0, 1]]
+    )
+    assert abs(m.accuracy - 4 / 6) < 1e-9
+    assert abs(m.error - 2 / 6) < 1e-9
+    # class 1: precision 2/3, recall 1
+    np.testing.assert_allclose(m.class_precision(), [1 / 2, 2 / 3, 1.0])
+    np.testing.assert_allclose(m.class_recall(), [1 / 2, 1.0, 1 / 2])
+    assert m.micro_f1 == m.accuracy
+    assert "Confusion Matrix" in m.summary()
+
+
+def test_multiclass_masks_padding():
+    actual = jnp.asarray([0, 1, 0, 0])
+    pred = jnp.asarray([0, 1, 0, 0])
+    m = MulticlassClassifierEvaluator(2)(pred, actual, n_valid=2)
+    assert m.total == 2
+    assert m.accuracy == 1.0
+
+
+def test_binary_metrics():
+    pred = jnp.asarray([True, True, False, False, True])
+    actual = jnp.asarray([True, False, False, True, True])
+    m = BinaryClassifierEvaluator()(pred, actual)
+    assert (m.tp, m.fp, m.tn, m.fn) == (2, 1, 1, 1)
+    assert abs(m.accuracy - 3 / 5) < 1e-9
+    assert abs(m.precision - 2 / 3) < 1e-9
+    assert abs(m.recall - 2 / 3) < 1e-9
+    assert abs(m.f1 - 2 / 3) < 1e-9
+    merged = m + m
+    assert merged.tp == 4 and merged.total == 10
+
+
+def test_mean_ap_perfect_and_worst():
+    k = 2
+    actuals = np.array([[1, -1], [1, -1], [-1, 1], [-1, 1]])
+    # perfect scores for class 0, inverted for class 1
+    scores = np.array(
+        [[0.9, 0.1], [0.8, 0.2], [0.1, 0.05], [0.2, 0.01]], np.float32
+    )
+    aps = MeanAveragePrecisionEvaluator(k)(actuals, scores)
+    assert abs(aps[0] - 1.0) < 1e-6  # positives ranked top
+    assert aps[1] < 1.0
+    # no positives → AP 0
+    aps0 = MeanAveragePrecisionEvaluator(1)(np.full((3, 1), -1), scores[:3, :1])
+    assert aps0[0] == 0.0
+
+
+def test_mean_ap_known_value():
+    # one class: ranks (pos, neg, pos) → precision at hits: 1, 2/3
+    actuals = np.array([[1], [-1], [1]])
+    scores = np.array([[0.9], [0.8], [0.7]], np.float32)
+    ap = MeanAveragePrecisionEvaluator(1)(actuals, scores)[0]
+    # recall grid: t<=0.5 → max prec 1.0 (6 pts), t>0.5 → 2/3 (5 pts)
+    expected = (6 * 1.0 + 5 * (2 / 3)) / 11
+    assert abs(ap - expected) < 1e-6

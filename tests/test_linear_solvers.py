@@ -1,0 +1,133 @@
+"""Solver tests — property-based, mirroring the reference's strategy
+(SURVEY.md §4.2): zero-gradient at the solution, block≡full equivalence,
+sharded≡unsharded equality."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.ops.linear import (
+    BlockLeastSquaresEstimator,
+    BlockLinearMapper,
+    LinearMapEstimator,
+    LinearMapper,
+)
+from keystone_tpu.parallel.mesh import shard_batch
+
+
+def _planted(rng, n=200, d=12, k=3, noise=0.0):
+    a = rng.normal(size=(n, d)).astype(np.float32)
+    x_true = rng.normal(size=(d, k)).astype(np.float32)
+    b = a @ x_true + 2.5 + noise * rng.normal(size=(n, k)).astype(np.float32)
+    return jnp.asarray(a), jnp.asarray(b), x_true
+
+
+def test_linear_map_estimator_recovers_planted_model(rng):
+    a, b, x_true = _planted(rng)
+    model = LinearMapEstimator(lam=0.0).fit(a, b)
+    np.testing.assert_allclose(np.asarray(model.x), x_true, atol=1e-2)
+    pred = model(a)
+    np.testing.assert_allclose(np.asarray(pred), np.asarray(b), atol=1e-2)
+
+
+def test_linear_map_estimator_ridge_gradient_zero(rng):
+    """∇(‖A_c x − b_c‖² + λ‖x‖²) ≈ 0 at the solution (reference
+    BlockWeightedLeastSquaresSuite zero-gradient idiom)."""
+    a, b, _ = _planted(rng, noise=0.5)
+    lam = 3.0
+    model = LinearMapEstimator(lam=lam).fit(a, b)
+    a_c = np.asarray(a) - np.asarray(a).mean(0)
+    b_c = np.asarray(b) - np.asarray(b).mean(0)
+    x = np.asarray(model.x)
+    grad = a_c.T @ (a_c @ x - b_c) + lam * x
+    assert np.abs(grad).max() < 1e-1
+
+
+def test_bcd_matches_exact_solve(rng):
+    a, b, _ = _planted(rng, n=150, d=20, noise=0.3)
+    lam = 1.0
+    exact = LinearMapEstimator(lam=lam).fit(a, b)
+    bcd = BlockLeastSquaresEstimator(block_size=7, num_iter=40, lam=lam).fit(a, b)
+    np.testing.assert_allclose(
+        np.asarray(bcd(a)), np.asarray(exact(a)), atol=5e-2
+    )
+
+
+def test_bcd_gradient_zero_at_solution(rng):
+    a, b, _ = _planted(rng, n=100, d=16, noise=0.2)
+    lam = 2.0
+    est = BlockLeastSquaresEstimator(block_size=5, num_iter=50, lam=lam)
+    model = est.fit(a, b)
+    # reconstruct full centered system
+    a_np, b_np = np.asarray(a), np.asarray(b)
+    blocks = [a_np[:, s : s + 5] for s in range(0, 16, 5)]
+    x_full = np.concatenate([np.asarray(x) for x in model.xs], axis=0)
+    a_c = np.concatenate(
+        [blk - blk.mean(0) for blk in blocks], axis=1
+    )
+    b_c = b_np - b_np.mean(0)
+    grad = a_c.T @ (a_c @ x_full - b_c) + lam * x_full
+    assert np.abs(grad).max() < 1e-2 * (1 + np.abs(b_c).max())
+
+
+def test_block_mapper_equals_linear_mapper(rng):
+    """BlockLinearMapper output must match LinearMapper on the same weights
+    (reference BlockLinearMapperSuite)."""
+    a, _, _ = _planted(rng, n=40, d=10)
+    w = rng.normal(size=(10, 4)).astype(np.float32)
+    intercept = rng.normal(size=(4,)).astype(np.float32)
+    full = LinearMapper(x=jnp.asarray(w), b=jnp.asarray(intercept))
+    blocked = BlockLinearMapper(
+        xs=(jnp.asarray(w[:3]), jnp.asarray(w[3:6]), jnp.asarray(w[6:])),
+        b=jnp.asarray(intercept),
+        block_size=3,
+    )
+    np.testing.assert_allclose(
+        np.asarray(blocked(a)), np.asarray(full(a)), atol=1e-4
+    )
+
+
+def test_apply_and_evaluate_streams_blocks(rng):
+    a, _, _ = _planted(rng, n=20, d=9)
+    w = rng.normal(size=(9, 2)).astype(np.float32)
+    mapper = BlockLinearMapper(
+        xs=(jnp.asarray(w[:3]), jnp.asarray(w[3:6]), jnp.asarray(w[6:])),
+        b=None,
+        block_size=3,
+    )
+    seen = []
+    mapper.apply_and_evaluate(a, lambda out: seen.append(np.asarray(out)))
+    assert len(seen) == 3
+    np.testing.assert_allclose(seen[-1], np.asarray(mapper(a)), atol=1e-5)
+    partial_first = np.asarray(a)[:, :3] @ w[:3]
+    np.testing.assert_allclose(seen[0], partial_first, atol=1e-5)
+
+
+def test_sharded_fit_matches_unsharded(rng, mesh8):
+    a, b, _ = _planted(rng, n=64, d=8, noise=0.1)
+    model_local = LinearMapEstimator(lam=0.5).fit(a, b)
+    a_s, b_s = shard_batch(a, mesh8), shard_batch(b, mesh8)
+    model_shard = LinearMapEstimator(lam=0.5).fit(a_s, b_s)
+    np.testing.assert_allclose(
+        np.asarray(model_shard.x), np.asarray(model_local.x), atol=1e-4
+    )
+
+
+def test_padded_fit_masks_rows(rng, mesh8):
+    """Fit on a zero-padded sharded batch must equal the unpadded fit."""
+    a, b, _ = _planted(rng, n=50, d=6, noise=0.1)  # 50 pads to 56
+    model_local = LinearMapEstimator(lam=0.5).fit(a, b)
+    a_s = shard_batch(a, mesh8)
+    b_s = shard_batch(b, mesh8)
+    model_pad = LinearMapEstimator(lam=0.5).fit(a_s, b_s, n_valid=50)
+    np.testing.assert_allclose(
+        np.asarray(model_pad.x), np.asarray(model_local.x), atol=1e-3
+    )
+    bcd_local = BlockLeastSquaresEstimator(block_size=4, num_iter=20, lam=0.5).fit(
+        a, b
+    )
+    bcd_pad = BlockLeastSquaresEstimator(block_size=4, num_iter=20, lam=0.5).fit(
+        a_s, b_s, n_valid=50
+    )
+    np.testing.assert_allclose(
+        np.asarray(bcd_pad(a)), np.asarray(bcd_local(a)), atol=1e-3
+    )
