@@ -1,0 +1,128 @@
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Workload: the reference's README example workload shape — MnistRandomFFT
+(60k×784 synthetic MNIST-shaped data, numFFTs=4, blockSize=2048; README
+"Example: MNIST pipeline") measured as end-to-end featurize+fit samples/sec
+on the available accelerator.
+
+Baseline: the same computation in numpy/BLAS on this host's CPU (the moral
+stand-in for the reference's single-node Spark local mode — the reference
+repo publishes no numbers, see BASELINE.md). The O(N) phases (featurize,
+Gram) are measured on a subset and scaled; the fixed O(d³) solve is timed
+once at full width and added unscaled.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+N_TRAIN = 60_000
+IMAGE_SIZE = 784
+NUM_FFTS = 4
+BLOCK_SIZE = 2048
+LAM = 1e-2
+CPU_SUBSET = 6_000
+
+
+def _synthetic(n: int) -> tuple[np.ndarray, np.ndarray]:
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, 10, size=n).astype(np.int32)
+    centers = np.random.default_rng(42).normal(size=(10, IMAGE_SIZE)).astype(
+        np.float32
+    )
+    data = centers[labels] + rng.normal(size=(n, IMAGE_SIZE)).astype(np.float32)
+    return labels, data
+
+
+def bench_tpu(labels: np.ndarray, data: np.ndarray) -> float:
+    import jax
+
+    from keystone_tpu.models import mnist_random_fft as m
+    from keystone_tpu.ops.linear import BlockLeastSquaresEstimator
+    from keystone_tpu.ops.util import ClassLabelIndicators
+    from keystone_tpu.parallel.mesh import create_mesh, shard_batch
+
+    mesh = create_mesh() if len(jax.devices()) > 1 else None
+    n = len(labels)
+    x = shard_batch(data, mesh)
+    y = ClassLabelIndicators(num_classes=10)(
+        np.pad(labels, (0, x.shape[0] - n))
+    )
+    feats = m.build_batch_featurizers(NUM_FFTS, BLOCK_SIZE, seed=0)
+    est = BlockLeastSquaresEstimator(block_size=BLOCK_SIZE, num_iter=1, lam=LAM)
+
+    def step():
+        blocks = m.featurize(feats, x)
+        return est.fit(blocks, y, n_valid=n)
+
+    def sync(model):
+        # host transfer of a scalar guarantees execution completed (under
+        # the axon tunnel block_until_ready alone can return early)
+        return float(np.asarray(model.xs[0][0, 0]))
+
+    sync(step())  # compile + warm
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        sync(step())
+        times.append(time.perf_counter() - t0)
+    return n / sorted(times)[1]  # median
+
+
+def bench_cpu_numpy(
+    labels: np.ndarray, data: np.ndarray, full_n: int
+) -> float:
+    """Same math in numpy/BLAS (single host CPU baseline). O(N) phases are
+    timed on the given subset and scaled to ``full_n``; the O(d³) solve is
+    timed once and added unscaled."""
+    n = len(labels)
+    rng = np.random.default_rng(7)
+    signs = rng.choice([-1.0, 1.0], size=(NUM_FFTS, IMAGE_SIZE)).astype(
+        np.float32
+    )
+    onehot = -np.ones((n, 10), np.float32)
+    onehot[np.arange(n), labels] = 1.0
+
+    t0 = time.perf_counter()
+    blocks = []
+    for f in range(NUM_FFTS):
+        padded = np.zeros((n, 1024), np.float32)
+        padded[:, :IMAGE_SIZE] = data * signs[f]
+        feat = np.maximum(np.real(np.fft.rfft(padded, axis=1))[:, :512], 0.0)
+        blocks.append(feat)
+    a = np.concatenate(blocks, axis=1)
+    a -= a.mean(axis=0)
+    b = onehot - onehot.mean(axis=0)
+    ata = a.T @ a + LAM * np.eye(a.shape[1], dtype=np.float32)
+    atb = a.T @ b
+    t_linear = time.perf_counter() - t0
+    np.linalg.solve(ata, atb)
+    t_solve = time.perf_counter() - t0 - t_linear
+    return full_n / (t_linear * (full_n / n) + t_solve)
+
+
+def main() -> None:
+    labels, data = _synthetic(N_TRAIN)
+    tpu_rate = bench_tpu(labels, data)
+    cpu_rate = bench_cpu_numpy(labels[:CPU_SUBSET], data[:CPU_SUBSET], N_TRAIN)
+    print(
+        json.dumps(
+            {
+                "metric": "mnist_random_fft featurize+fit samples/sec",
+                "value": round(tpu_rate, 1),
+                "unit": "samples/s",
+                "vs_baseline": round(tpu_rate / cpu_rate, 2),
+                "baseline_samples_per_s": round(cpu_rate, 1),
+                "baseline": "numpy/BLAS single-host CPU, same workload "
+                "(reference publishes no numbers; see BASELINE.md)",
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,11 @@
+"""Host-side data ingestion (reference ``src/main/scala/loaders/``, SURVEY.md §2.7).
+
+Loaders parse on the host (CSV/binary/tar/JPEG) into numpy, then feed the
+mesh via ``parallel.mesh.shard_batch`` — the successor of one-partition-per-
+file RDD ingestion.
+"""
+
+from keystone_tpu.loaders.labeled import LabeledData
+from keystone_tpu.loaders.csv_loader import load_csv, load_labeled_csv
+
+__all__ = ["LabeledData", "load_csv", "load_labeled_csv"]

@@ -1,0 +1,47 @@
+"""CSV loading (reference loaders/CsvDataLoader.scala): rows of
+comma-separated numbers → one matrix; optional first-column labels."""
+
+from __future__ import annotations
+
+import glob
+import os
+
+import numpy as np
+
+from keystone_tpu.loaders.labeled import LabeledData
+
+
+def _paths(path: str) -> list[str]:
+    """A file, a directory of part files, or a glob — like sc.textFile."""
+    if os.path.isdir(path):
+        found = sorted(
+            p
+            for p in glob.glob(os.path.join(path, "*"))
+            if os.path.isfile(p) and not os.path.basename(p).startswith(("_", "."))
+        )
+    else:
+        found = sorted(glob.glob(path)) or [path]
+    if not found or not all(os.path.exists(p) for p in found):
+        raise FileNotFoundError(path)
+    return found
+
+
+def load_csv(path: str, dtype=np.float32) -> np.ndarray:
+    """All rows from file/dir/glob ``path`` as an (N, d) array."""
+    parts = [
+        np.loadtxt(p, delimiter=",", dtype=dtype, ndmin=2) for p in _paths(path)
+    ]
+    return parts[0] if len(parts) == 1 else np.concatenate(parts, axis=0)
+
+
+def load_labeled_csv(
+    path: str, label_offset: int = 0, dtype=np.float32
+) -> LabeledData:
+    """First column = integer label (minus ``label_offset``), rest = features.
+
+    MNIST csvs in the reference workload are 1-indexed → ``label_offset=1``
+    (the reference subtracts 1 inline, MnistRandomFFT.scala ``x(0).toInt - 1``).
+    """
+    mat = load_csv(path, dtype=dtype)
+    labels = mat[:, 0].astype(np.int32) - label_offset
+    return LabeledData(labels=labels, data=np.ascontiguousarray(mat[:, 1:]))

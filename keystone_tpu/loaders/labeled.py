@@ -1,0 +1,25 @@
+"""LabeledData — (labels, data) bundle (reference loaders/LabeledData.scala)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+
+@dataclasses.dataclass
+class LabeledData:
+    """Bundle of a data batch with its labels, with ``.data`` / ``.labels``
+    projections. Batches stay aligned by construction (same leading axis) —
+    the 'zip of co-partitioned RDDs' invariant is structural here."""
+
+    labels: Any
+    data: Any
+
+    def __post_init__(self):
+        n_l = len(self.labels)
+        n_d = self.data.shape[0] if hasattr(self.data, "shape") else len(self.data)
+        if n_l != n_d:
+            raise ValueError(f"labels ({n_l}) and data ({n_d}) row counts differ")
+
+    def __len__(self) -> int:
+        return len(self.labels)

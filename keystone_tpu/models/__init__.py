@@ -1,0 +1,6 @@
+"""End-to-end applications (reference ``src/main/scala/pipelines/``, SURVEY.md §2 layer 7).
+
+Each module exposes a config dataclass, ``run(conf, mesh=None)`` returning a
+metrics dict, and ``main(argv)`` wiring the auto-generated CLI — the
+successor of the reference's scopt ``parse``/``run``/``main`` objects.
+"""

@@ -42,15 +42,43 @@ def _row_mask(n_rows: int, n_valid, dtype) -> jnp.ndarray:
     return (jnp.arange(n_rows) < n_valid)[:, None].astype(dtype)
 
 
-def ridge_solve(ata: jnp.ndarray, atb: jnp.ndarray, lam: float) -> jnp.ndarray:
+def ridge_solve(
+    ata: jnp.ndarray,
+    atb: jnp.ndarray,
+    lam: float,
+    refine: int = 2,
+    jitter: float = 1e-6,
+) -> jnp.ndarray:
     """Solve ``(AᵀA + λI) X = AᵀB`` — the NormalEquations primitive.
 
-    SPD for λ>0: Cholesky (what LAPACK's \\ would pick); tiny replicated
-    compute, runs identically on every chip.
+    The reference does this in f64 LAPACK where Gram conditioning is a
+    non-issue; in f32 on TPU the normal equations square A's condition
+    number, so a raw Cholesky NaNs out on realistic features (e.g. the
+    random-FFT pipeline's O(700)-scale features). Stabilized while staying
+    f32:
+
+    - diagonal (Jacobi) equilibration of the Gram,
+    - a relative ``jitter`` floor keeping the factorization positive even
+      when λ is tiny vs the Gram scale,
+    - ``refine`` steps of iterative refinement against the *original*
+      system, recovering the accuracy the equilibrated factor loses.
+
+    Tiny replicated compute; runs identically on every chip.
     """
     d = ata.shape[0]
-    ata = ata + lam * jnp.eye(d, dtype=ata.dtype)
-    return jax.scipy.linalg.cho_solve(jax.scipy.linalg.cho_factor(ata), atb)
+    inv_s = jax.lax.rsqrt(jnp.clip(jnp.diagonal(ata), 1e-30, None))
+    m = ata * (inv_s[:, None] * inv_s[None, :])
+    m = m + jnp.diag(lam * inv_s * inv_s) + jitter * jnp.eye(d, dtype=ata.dtype)
+    cf = jax.scipy.linalg.cho_factor(m)
+
+    def solve_prec(rhs):
+        return inv_s[:, None] * jax.scipy.linalg.cho_solve(cf, rhs * inv_s[:, None])
+
+    x = solve_prec(atb)
+    for _ in range(refine):
+        r = atb - (ata @ x + lam * x)
+        x = x + solve_prec(r)
+    return x
 
 
 @treenode

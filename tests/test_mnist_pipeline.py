@@ -1,0 +1,37 @@
+"""End-to-end MNIST random-FFT pipeline test (reference MnistRandomFFT)."""
+
+import numpy as np
+
+from keystone_tpu.models import mnist_random_fft as m
+from keystone_tpu.parallel.mesh import create_mesh
+
+
+def test_batch_featurizer_grouping():
+    batches = m.build_batch_featurizers(num_ffts=5, block_size=2048, seed=0)
+    assert [len(b) for b in batches] == [4, 1]  # 4 ffts of 512 per 2048 block
+
+
+def test_synthetic_end_to_end_single_device():
+    conf = m.MnistRandomFFTConfig(
+        synthetic=256, num_ffts=2, block_size=1024, lam=10.0
+    )
+    res = m.run(conf, mesh=None)
+    assert res["train_error"] < 0.1  # separable synthetic classes
+    assert res["test_error"] < 0.3
+    assert res["n_train"] == 256
+
+
+def test_synthetic_end_to_end_mesh(mesh8):
+    conf = m.MnistRandomFFTConfig(
+        synthetic=250, num_ffts=2, block_size=1024, lam=10.0, seed=3
+    )
+    res = m.run(conf, mesh=mesh8)  # 250 pads to 256 on 8-way mesh
+    assert res["train_error"] < 0.1
+    # mesh result must match single-device result (same seed/config)
+    res_local = m.run(conf, mesh=None)
+    assert abs(res["train_error"] - res_local["train_error"]) < 0.02
+
+
+def test_cli_main_synthetic():
+    res = m.main(["--synthetic", "128", "--num-ffts", "1", "--block-size", "512"])
+    assert "test_error" in res
