@@ -1,0 +1,51 @@
+"""Chunked application of jitted transforms over large host datasets.
+
+The Spark-partition streaming analog: when the intermediate tensors of a
+featurizer are much larger than its input/output (e.g. im2col patches), the
+whole dataset can't be materialized through it at once. ``apply_in_chunks``
+streams fixed-size chunks through a single compiled program (last chunk
+zero-padded so every call hits the same executable) and reassembles the
+output on the host or device.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import numpy as np
+
+
+def apply_in_chunks(
+    fn: Callable,
+    data,
+    chunk_size: int,
+    *,
+    to_host: bool = False,
+):
+    """Apply ``fn`` (ideally jitted) to ``data`` in fixed-size chunks along
+    axis 0. The last chunk is zero-padded to ``chunk_size`` (one executable)
+    and its padding rows are dropped from the result."""
+    n = data.shape[0]
+    if n <= chunk_size:
+        out = fn(data)
+        return np.asarray(out) if to_host else out
+    outs = []
+    for start in range(0, n, chunk_size):
+        chunk = data[start : start + chunk_size]
+        valid = chunk.shape[0]
+        if valid < chunk_size:
+            pad = [(0, chunk_size - valid)] + [(0, 0)] * (chunk.ndim - 1)
+            chunk = (
+                np.pad(chunk, pad)
+                if isinstance(chunk, np.ndarray)
+                else jax.numpy.pad(chunk, pad)
+            )
+        out = fn(chunk)
+        out = out[:valid]
+        outs.append(np.asarray(out) if to_host else out)
+    if to_host:
+        return np.concatenate(outs, axis=0)
+    import jax.numpy as jnp
+
+    return jnp.concatenate(outs, axis=0)

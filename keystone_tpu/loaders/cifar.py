@@ -1,0 +1,40 @@
+"""CIFAR-10 binary loader (reference loaders/CifarLoader.scala).
+
+Record format: 1 label byte + 3072 pixel bytes (1024 R, 1024 G, 1024 B
+planes, row-major). Parsed on the host in one vectorized pass → (N, 32, 32,
+3) float batch with values 0-255 (apply PixelScaler for [0,1]).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+
+import numpy as np
+
+from keystone_tpu.utils.images import LabeledImages
+
+NROW, NCOL, NCHAN = 32, 32, 3
+RECORD = 1 + NROW * NCOL * NCHAN
+
+
+def load_cifar(path: str, dtype=np.float32) -> LabeledImages:
+    """Load all records from a CIFAR-10 binary file, directory, or glob."""
+    if os.path.isdir(path):
+        files = sorted(glob.glob(os.path.join(path, "*.bin")))
+    else:
+        files = sorted(glob.glob(path)) or [path]
+    raws = []
+    for f in files:
+        raw = np.fromfile(f, dtype=np.uint8)
+        if raw.size % RECORD:
+            raise ValueError(
+                f"{f}: size {raw.size} is not a multiple of the "
+                f"{RECORD}-byte CIFAR-10 record"
+            )
+        raws.append(raw.reshape(-1, RECORD))
+    recs = np.concatenate(raws, axis=0)
+    labels = recs[:, 0].astype(np.int32)
+    planes = recs[:, 1:].reshape(-1, NCHAN, NROW, NCOL)  # (N, C, H, W)
+    images = np.transpose(planes, (0, 2, 3, 1)).astype(dtype)  # NHWC
+    return LabeledImages(labels=labels, images=images)

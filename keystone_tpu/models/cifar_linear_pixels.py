@@ -1,0 +1,103 @@
+"""CIFAR-10 LinearPixels — grayscale pixels + exact linear solve
+(reference ``pipelines/images/cifar/LinearPixels.scala``)."""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import jax
+import numpy as np
+
+from keystone_tpu.core.config import arg, parse_config
+from keystone_tpu.core.logging import get_logger
+from keystone_tpu.evaluation import MulticlassClassifierEvaluator
+from keystone_tpu.loaders.cifar import load_cifar
+from keystone_tpu.ops.images import GrayScaler, ImageVectorizer
+from keystone_tpu.ops.linear import LinearMapEstimator
+from keystone_tpu.ops.util import ClassLabelIndicators, MaxClassifier
+from keystone_tpu.parallel.mesh import create_mesh, shard_batch
+from keystone_tpu.utils.images import LabeledImages
+
+logger = get_logger("keystone_tpu.models.cifar_linear_pixels")
+
+NUM_CLASSES = 10
+
+
+@dataclasses.dataclass
+class LinearPixelsConfig:
+    """CIFAR LinearPixels workload (reference LinearPixelsConfig)."""
+
+    train_location: str = arg(default="", help="CIFAR-10 binary file/dir")
+    test_location: str = arg(default="", help="CIFAR-10 binary file/dir")
+    lam: float = arg(default=0.0, help="L2 regularization")
+    synthetic: int = arg(default=0, help="if > 0, N synthetic samples")
+
+
+def _load(conf: LinearPixelsConfig, which: str) -> LabeledImages:
+    if conf.synthetic:
+        n = conf.synthetic if which == "train" else max(conf.synthetic // 5, 1)
+        rng = np.random.default_rng(0 if which == "train" else 1)
+        labels = rng.integers(0, NUM_CLASSES, size=n).astype(np.int32)
+        centers = np.random.default_rng(42).normal(
+            loc=128, scale=40, size=(NUM_CLASSES, 32, 32, 3)
+        )
+        images = (
+            centers[labels] + rng.normal(scale=25, size=(n, 32, 32, 3))
+        ).astype(np.float32)
+        return LabeledImages(labels=labels, images=images)
+    return load_cifar(conf.train_location if which == "train" else conf.test_location)
+
+
+def run(conf: LinearPixelsConfig, mesh=None) -> dict:
+    if mesh is None and len(jax.devices()) > 1:
+        mesh = create_mesh()
+    t0 = time.perf_counter()
+    train, test = _load(conf, "train"), _load(conf, "test")
+
+    featurizer = GrayScaler() >> ImageVectorizer()
+    feat_jit = jax.jit(lambda p, b: p(b))
+
+    x_train = shard_batch(train.images, mesh)
+    x_test = shard_batch(test.images, mesh)
+    y = np.zeros(x_train.shape[0], np.int32)
+    y[: len(train)] = train.labels
+    indicators = ClassLabelIndicators(num_classes=NUM_CLASSES)(y)
+
+    f_train = feat_jit(featurizer, x_train)
+    model = LinearMapEstimator(lam=conf.lam).fit(
+        f_train, indicators, n_valid=len(train)
+    )
+
+    predict = featurizer >> model >> MaxClassifier()
+    evaluator = MulticlassClassifierEvaluator(NUM_CLASSES)
+    pred_train = feat_jit(predict, x_train)
+    train_eval = evaluator(pred_train, y, n_valid=len(train))
+    y_test = np.zeros(x_test.shape[0], np.int32)
+    y_test[: len(test)] = test.labels
+    test_eval = evaluator(feat_jit(predict, x_test), y_test, n_valid=len(test))
+
+    result = {
+        "train_error": train_eval.error,
+        "test_error": test_eval.error,
+        "n_train": len(train),
+        "n_test": len(test),
+        "total_s": time.perf_counter() - t0,
+    }
+    logger.info(
+        "LinearPixels: train acc %.4f, test acc %.4f",
+        train_eval.accuracy,
+        test_eval.accuracy,
+    )
+    return result
+
+
+def main(argv=None) -> dict:
+    conf = parse_config(LinearPixelsConfig, argv)
+    if not conf.synthetic and not (conf.train_location and conf.test_location):
+        raise SystemExit("need --train-location AND --test-location, or --synthetic N")
+    return run(conf)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,199 @@
+"""CIFAR-10 random-patch convolution pipeline — the flagship image workload
+(reference ``pipelines/images/cifar/RandomPatchCifar.scala``).
+
+Stages (reference-parity):
+1. sample random patches from training images (Windower → vectorize → sample)
+2. per-patch normalize (``Stats.normalizeRows`` var-constant 10) and fit a
+   ZCA whitener on the patch sample
+3. filters = whitened, L2-normalized random patches, folded back through
+   ``W.T`` so convolution operates on mean-subtracted normalized patches
+4. featurize: im2col Convolver → SymmetricRectifier → sum Pooler →
+   vectorize → StandardScaler
+5. block least squares on ±1 indicators → argmax → multiclass eval
+
+TPU shape: featurization streams image chunks through one jitted program
+(im2col patches are the big intermediate); the solver contracts over the
+sharded data axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.core.batching import apply_in_chunks
+from keystone_tpu.core.config import arg, parse_config
+from keystone_tpu.core.logging import get_logger
+from keystone_tpu.evaluation import MulticlassClassifierEvaluator
+from keystone_tpu.loaders.cifar import load_cifar
+from keystone_tpu.models.cifar_linear_pixels import _load as _load_cifar_or_synth
+from keystone_tpu.ops.images import (
+    Convolver,
+    ImageVectorizer,
+    Pooler,
+    SymmetricRectifier,
+    Windower,
+    normalize_patch_rows,
+)
+from keystone_tpu.ops.linalg import ZCAWhitenerEstimator
+from keystone_tpu.ops.linear import BlockLeastSquaresEstimator
+from keystone_tpu.ops.stats import StandardScaler
+from keystone_tpu.ops.util import ClassLabelIndicators, MaxClassifier
+from keystone_tpu.parallel.mesh import create_mesh, shard_batch
+
+logger = get_logger("keystone_tpu.models.cifar_random_patch")
+
+NUM_CLASSES = 10
+WHITENER_SAMPLES = 100_000
+
+
+@dataclasses.dataclass
+class RandomCifarConfig:
+    """Random-patch CIFAR workload (reference RandomCifarConfig)."""
+
+    train_location: str = arg(default="", help="CIFAR-10 binary file/dir")
+    test_location: str = arg(default="", help="CIFAR-10 binary file/dir")
+    num_filters: int = arg(default=100)
+    patch_size: int = arg(default=6)
+    patch_steps: int = arg(default=1)
+    pool_size: int = arg(default=14)
+    pool_stride: int = arg(default=13)
+    alpha: float = arg(default=0.25, help="rectifier offset")
+    lam: float = arg(default=0.0, help="L2 regularization")
+    block_size: int = arg(default=4096)
+    chunk_size: int = arg(default=1024, help="featurization chunk (images)")
+    seed: int = arg(default=0)
+    synthetic: int = arg(default=0, help="if > 0, N synthetic samples")
+
+
+def build_filters(
+    images: np.ndarray, conf: RandomCifarConfig
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Sample patches, fit ZCA, construct whitened-normalized filters.
+
+    Returns (filters (F, k²C), whitener_means (k²C,)) — the whitener itself
+    is folded into the filters (reference: ``(normalized) * whitener.t``).
+    """
+    rng = np.random.default_rng(conf.seed)
+    # sample enough images that their windows cover WHITENER_SAMPLES
+    per_image = (
+        (images.shape[1] - conf.patch_size) // conf.patch_steps + 1
+    ) ** 2
+    n_img = min(images.shape[0], max(WHITENER_SAMPLES // max(per_image, 1), 1) * 2)
+    idx = rng.choice(images.shape[0], size=n_img, replace=False)
+    windows = Windower(stride=conf.patch_steps, window_size=conf.patch_size)(
+        jnp.asarray(images[np.sort(idx)])
+    )
+    flat = ImageVectorizer()(windows)
+    if flat.shape[0] > WHITENER_SAMPLES:
+        sel = rng.choice(flat.shape[0], WHITENER_SAMPLES, replace=False)
+        flat = jnp.take(flat, jnp.asarray(np.sort(sel)), axis=0)
+
+    base = normalize_patch_rows(flat, 10.0)
+    whitener = ZCAWhitenerEstimator().fit(base)
+
+    sel = rng.choice(base.shape[0], conf.num_filters, replace=False)
+    sample_filters = jnp.take(base, jnp.asarray(np.sort(sel)), axis=0)
+    unnorm = whitener(sample_filters)
+    norms = jnp.linalg.norm(unnorm, axis=1, keepdims=True)
+    filters = (unnorm / (norms + 1e-10)) @ whitener.whitener.T
+    return filters, whitener.means
+
+
+def run(conf: RandomCifarConfig, mesh=None) -> dict:
+    if mesh is None and len(jax.devices()) > 1:
+        mesh = create_mesh()
+    t0 = time.perf_counter()
+    train = _load_cifar_or_synth(_as_lp_conf(conf), "train")
+    test = _load_cifar_or_synth(_as_lp_conf(conf), "test")
+
+    filters, means = build_filters(train.images, conf)
+    conv_featurizer = (
+        Convolver(
+            filters=filters,
+            whitener_means=means,
+            patch_size=conf.patch_size,
+            normalize_patches=True,
+        )
+        >> SymmetricRectifier(alpha=conf.alpha)
+        >> Pooler(stride=conf.pool_stride, pool_size=conf.pool_size)
+        >> ImageVectorizer()
+    )
+    feat_fn = jax.jit(lambda b, p=conv_featurizer: p(b))
+    t_setup = time.perf_counter()
+
+    def featurize(images: np.ndarray):
+        x = shard_batch(images, mesh)
+        return apply_in_chunks(feat_fn, x, conf.chunk_size)
+
+    f_train_raw = featurize(train.images)
+    scaler = StandardScaler().fit(f_train_raw, n_valid=len(train))
+    f_train = scaler(f_train_raw)
+
+    y = np.zeros(f_train.shape[0], np.int32)
+    y[: len(train)] = train.labels
+    indicators = ClassLabelIndicators(num_classes=NUM_CLASSES)(y)
+    t_feat = time.perf_counter()
+
+    est = BlockLeastSquaresEstimator(
+        block_size=conf.block_size, num_iter=1, lam=conf.lam
+    )
+    model = jax.block_until_ready(
+        est.fit(f_train, indicators, n_valid=len(train))
+    )
+    t_fit = time.perf_counter()
+
+    classify = MaxClassifier()
+    evaluator = MulticlassClassifierEvaluator(NUM_CLASSES)
+    pred_train = classify(model(f_train))
+    train_eval = evaluator(pred_train, y, n_valid=len(train))
+
+    f_test = scaler(featurize(test.images))
+    y_test = np.zeros(f_test.shape[0], np.int32)
+    y_test[: len(test)] = test.labels
+    test_eval = evaluator(classify(model(f_test)), y_test, n_valid=len(test))
+    t_end = time.perf_counter()
+
+    result = {
+        "train_error": train_eval.error,
+        "test_error": test_eval.error,
+        "n_train": len(train),
+        "n_test": len(test),
+        "setup_s": t_setup - t0,
+        "featurize_s": t_feat - t_setup,
+        "fit_s": t_fit - t_feat,
+        "total_s": t_end - t0,
+        "featurize_fit_samples_per_s": len(train) / (t_fit - t_setup),
+    }
+    logger.info(
+        "RandomPatchCifar: train err %.4f, test err %.4f, %.0f samples/s",
+        train_eval.error,
+        test_eval.error,
+        result["featurize_fit_samples_per_s"],
+    )
+    return result
+
+
+def _as_lp_conf(conf: RandomCifarConfig):
+    from keystone_tpu.models.cifar_linear_pixels import LinearPixelsConfig
+
+    return LinearPixelsConfig(
+        train_location=conf.train_location,
+        test_location=conf.test_location,
+        synthetic=conf.synthetic,
+    )
+
+
+def main(argv=None) -> dict:
+    conf = parse_config(RandomCifarConfig, argv)
+    if not conf.synthetic and not (conf.train_location and conf.test_location):
+        raise SystemExit("need --train-location AND --test-location, or --synthetic N")
+    return run(conf)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,220 @@
+"""Image nodes (reference ``nodes/images/``, SURVEY.md §2.3).
+
+All nodes operate on (N, H, W, C) float batches. Patch/feature layouts
+flatten as (dy, dx, c) with channel fastest — the reference's patch index
+``c + x·C + y·C·k`` (Convolver.makePatches), so fitted filters/whiteners are
+layout-compatible across the whole stack.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.core.pipeline import FunctionNode, Transformer
+from keystone_tpu.core.treenode import static_field, treenode
+from keystone_tpu.utils.images import rgb_to_gray
+
+
+@treenode
+class GrayScaler(Transformer):
+    """MATLAB rgb2gray weights (reference ImageUtils.toGrayScale)."""
+
+    def __call__(self, batch):
+        return rgb_to_gray(batch)
+
+
+@treenode
+class PixelScaler(Transformer):
+    """Scale byte pixels to [0,1] (reference nodes/images/PixelScaler.scala)."""
+
+    def __call__(self, batch):
+        return batch / 255.0
+
+
+@treenode
+class ImageVectorizer(Transformer):
+    """(N, H, W, C) → (N, H·W·C), channel fastest
+    (reference nodes/images/ImageVectorizer.scala)."""
+
+    def __call__(self, batch):
+        return batch.reshape(batch.shape[0], -1)
+
+
+def extract_patches(batch, patch_size: int, stride: int = 1):
+    """All patch_size×patch_size windows at the given stride.
+
+    Returns (N, oh, ow, patch_size·patch_size·C) with (dy, dx, c) flattening,
+    channel fastest — matching the reference patch layout.
+    """
+    n, h, w, c = batch.shape
+    patches = jax.lax.conv_general_dilated_patches(
+        jnp.transpose(batch, (0, 3, 1, 2)),  # NCHW
+        filter_shape=(patch_size, patch_size),
+        window_strides=(stride, stride),
+        padding="VALID",
+    )  # (N, C*ph*pw, oh, ow), feature dim ordered (c, dy, dx)
+    oh, ow = patches.shape[2], patches.shape[3]
+    patches = patches.reshape(n, c, patch_size, patch_size, oh, ow)
+    # → (N, oh, ow, dy, dx, c): channel fastest in the flattened patch
+    patches = jnp.transpose(patches, (0, 4, 5, 2, 3, 1))
+    return patches.reshape(n, oh, ow, patch_size * patch_size * c)
+
+
+@treenode
+class Windower(FunctionNode):
+    """FlatMap each image into all stride-spaced square windows
+    (reference nodes/images/Windower.scala).
+
+    (N, H, W, C) → (N·n_windows, w, w, C).
+    """
+
+    stride: int = static_field(default=1)
+    window_size: int = static_field(default=6)
+
+    def __call__(self, batch):
+        n, _, _, c = batch.shape
+        w = self.window_size
+        p = extract_patches(batch, w, self.stride)
+        return p.reshape(n * p.shape[1] * p.shape[2], w, w, c)
+
+
+def normalize_patch_rows(mat, var_constant: float = 10.0):
+    """Per-row mean-center and divide by sqrt(var + alpha)
+    (reference utils/Stats.scala normalizeRows; var uses d-1 denominator)."""
+    d = mat.shape[-1]
+    mean = jnp.mean(mat, axis=-1, keepdims=True)
+    var = jnp.sum((mat - mean) ** 2, axis=-1, keepdims=True) / max(d - 1, 1)
+    return (mat - mean) / jnp.sqrt(var + var_constant)
+
+
+@treenode
+class Convolver(Transformer):
+    """Filter-bank convolution by im2col (reference nodes/images/Convolver.scala).
+
+    The reference packs every patch into a row, optionally normalizes each
+    patch (``Stats.normalizeRows`` with ``varConstant``), optionally
+    subtracts the whitener means, then does one gemm with the filter bank.
+    Per-patch normalization makes this NOT a plain convolution, so the
+    im2col design is kept: patches → normalize → subtract mean → MXU gemm.
+    Without normalization/whitening this lowers to the same FLOPs XLA would
+    emit for ``lax.conv``.
+
+    ``filters``: (num_filters, patch_size²·C), rows in (dy, dx, c) layout —
+    exactly what :class:`Windower`+:class:`ImageVectorizer` sampling or
+    ``RandomPatchCifar``-style whitened filter construction produces.
+    """
+
+    filters: jnp.ndarray
+    whitener_means: jnp.ndarray | None = None
+    patch_size: int = static_field(default=6)
+    normalize_patches: bool = static_field(default=True)
+    var_constant: float = static_field(default=10.0)
+
+    def __call__(self, batch):
+        p = extract_patches(batch, self.patch_size)  # (N, oh, ow, k²C)
+        if self.normalize_patches:
+            p = normalize_patch_rows(p, self.var_constant)
+        if self.whitener_means is not None:
+            p = p - self.whitener_means
+        return jnp.einsum(
+            "nhwp,fp->nhwf", p, self.filters.astype(p.dtype)
+        )
+
+
+@treenode
+class SymmetricRectifier(Transformer):
+    """x → [max(maxVal, x−α), max(maxVal, −x−α)] stacked on the channel axis
+    (reference nodes/images/SymmetricRectifier.scala): C → 2C channels."""
+
+    max_val: float = static_field(default=0.0)
+    alpha: float = static_field(default=0.0)
+
+    def __call__(self, batch):
+        pos = jnp.maximum(self.max_val, batch - self.alpha)
+        neg = jnp.maximum(self.max_val, -batch - self.alpha)
+        return jnp.concatenate([pos, neg], axis=-1)
+
+
+@treenode
+class Pooler(Transformer):
+    """Strided pooling with the reference's exact window geometry
+    (reference nodes/images/Pooler.scala):
+
+    - pool centers start at ``strideStart = pool_size // 2``,
+    - each window spans ``[x − pool_size/2, min(x + pool_size/2, dim))`` —
+      i.e. windows start at 0, stride apart, edge windows truncated,
+    - ``num_pools = ceil((dim − strideStart) / stride)``.
+
+    Implemented as pixel_fn → zero-pad right → ``lax.reduce_window``.
+    Zero padding reproduces the truncated edge windows for sum/max pooling
+    (the reference's pool buffer is likewise zero-filled). NOTE (reference
+    quirk, SURVEY.md §7): a mean pool would divide by the wrong count at
+    edges — replicated faithfully by dividing by pool_size².
+    """
+
+    stride: int = static_field(default=13)
+    pool_size: int = static_field(default=14)
+    pixel_fn: Callable | None = static_field(default=None)
+    pool_fn: str = static_field(default="sum")  # sum | max | mean
+
+    def __call__(self, batch):
+        if self.pixel_fn is not None:
+            batch = self.pixel_fn(batch)
+        n, h, w, c = batch.shape
+        ph = self._num_pools(h)
+        pw = self._num_pools(w)
+        pad_h = (ph - 1) * self.stride + self.pool_size - h
+        pad_w = (pw - 1) * self.stride + self.pool_size - w
+        if self.pool_fn == "max":
+            init, op = -jnp.inf, jax.lax.max
+            pad_val = -jnp.inf
+        else:
+            init, op = 0.0, jax.lax.add
+            pad_val = 0.0
+        if pad_h > 0 or pad_w > 0:
+            batch = jnp.pad(
+                batch,
+                ((0, 0), (0, max(pad_h, 0)), (0, max(pad_w, 0)), (0, 0)),
+                constant_values=pad_val,
+            )
+        out = jax.lax.reduce_window(
+            batch,
+            jnp.asarray(init, batch.dtype),
+            op,
+            window_dimensions=(1, self.pool_size, self.pool_size, 1),
+            window_strides=(1, self.stride, self.stride, 1),
+            padding="VALID",
+        )
+        if self.pool_fn == "mean":
+            out = out / float(self.pool_size * self.pool_size)
+        return out
+
+    def _num_pools(self, dim: int) -> int:
+        stride_start = self.pool_size // 2
+        return -(-(dim - stride_start) // self.stride)
+
+
+@treenode
+class LabelExtractor(Transformer):
+    """Project labels out of a LabeledImages batch
+    (reference nodes/images/LabeledImageExtractors.scala)."""
+
+    def __call__(self, batch):
+        return batch.labels
+
+
+@treenode
+class ImageExtractor(Transformer):
+    """Project images out of a LabeledImages batch."""
+
+    def __call__(self, batch):
+        return batch.images
+
+
+# Multi-label variants are the same projections; provided for parity.
+MultiLabelExtractor = LabelExtractor
+MultiLabeledImageExtractor = ImageExtractor

@@ -1,0 +1,139 @@
+"""Dense linear-algebra estimators: PCA, ZCA whitening, LDA
+(reference ``nodes/learning/PCA.scala``, ``ZCAWhitener.scala``,
+``LinearDiscriminantAnalysis.scala``).
+
+The reference collects samples to the driver and calls LAPACK directly; on
+TPU these are small replicated computations (``jnp.linalg`` lowers to XLA)
+— the "driver" disappears (SURVEY.md §2.11 gather-to-driver row).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.core.pipeline import Estimator, LabelEstimator, Transformer
+from keystone_tpu.core.treenode import static_field, treenode
+from keystone_tpu.ops.linear import LinearMapper
+
+
+@treenode
+class PCATransformer(Transformer):
+    """Project feature vectors: (N, d) @ pca_mat → (N, dims)
+    (reference PCATransformer ``pcaMat.t * in`` per vector)."""
+
+    pca_mat: jnp.ndarray  # (d, dims)
+
+    def __call__(self, batch):
+        return batch @ self.pca_mat
+
+
+@treenode
+class BatchPCATransformer(Transformer):
+    """Project feature-major descriptor matrices: (N, d, m) → (N, dims, m)
+    (reference BatchPCATransformer ``pcaMat.t * in``)."""
+
+    pca_mat: jnp.ndarray
+
+    def __call__(self, batch):
+        return jnp.einsum("dk,ndm->nkm", self.pca_mat.astype(batch.dtype), batch)
+
+
+def compute_pca(data, dims: int) -> jnp.ndarray:
+    """PCA matrix via SVD of the mean-centered sample, with the MATLAB sign
+    convention (largest-|coeff| element of each column positive) — matching
+    the reference's PCAEstimator.computePCA."""
+    data = jnp.asarray(data)
+    centered = data - jnp.mean(data, axis=0)
+    _, _, vt = jnp.linalg.svd(centered, full_matrices=False)
+    pca = vt.T  # (d, min(n, d)) columns = principal directions
+    col_max = jnp.max(pca, axis=0)
+    col_abs_max = jnp.max(jnp.abs(pca), axis=0)
+    signs = jnp.where(col_max == col_abs_max, 1.0, -1.0).astype(pca.dtype)
+    return (pca * signs)[:, :dims]
+
+
+@treenode
+class PCAEstimator(Estimator):
+    """Fit PCA on a (sampled) batch (reference PCAEstimator).
+
+    Columns-sampled descriptor sets should be pre-flattened to (N, d) rows
+    (ColumnSampler output).
+    """
+
+    dims: int = static_field(default=64)
+
+    def fit(self, data) -> PCATransformer:
+        return PCATransformer(pca_mat=compute_pca(data, self.dims))
+
+    def fit_batch(self, data) -> BatchPCATransformer:
+        return BatchPCATransformer(pca_mat=compute_pca(data, self.dims))
+
+
+@treenode
+class ZCAWhitener(Transformer):
+    """(x − mean) @ W (reference nodes/learning/ZCAWhitener.scala)."""
+
+    whitener: jnp.ndarray  # (d, d)
+    means: jnp.ndarray  # (d,)
+
+    def __call__(self, batch):
+        return (batch - self.means) @ self.whitener
+
+
+@treenode
+class ZCAWhitenerEstimator(Estimator):
+    """ZCA whitening matrix from the SVD of one centered sample matrix:
+    ``W = V diag((s²/(n−1) + 0.1)^-½) Vᵀ`` (reference ZCAWhitenerEstimator
+    — note the 0.1 variance floor is hardcoded there too; its ``eps``
+    constructor param is unused)."""
+
+    eps: float = static_field(default=0.1)
+
+    def fit(self, data) -> ZCAWhitener:
+        data = jnp.asarray(data)
+        means = jnp.mean(data, axis=0)
+        centered = data - means
+        n = data.shape[0]
+        _, s, vt = jnp.linalg.svd(centered, full_matrices=False)
+        scale = jax.lax.rsqrt(s * s / (n - 1.0) + self.eps)
+        whitener = (vt.T * scale) @ vt
+        return ZCAWhitener(whitener=whitener, means=means)
+
+
+@treenode
+class LinearDiscriminantAnalysis(LabelEstimator):
+    """Multi-class LDA (reference nodes/learning/LinearDiscriminantAnalysis.scala).
+
+    The reference eigendecomposes ``inv(S_W)·S_B`` (nonsymmetric); TPUs have
+    no nonsymmetric eig, so the equivalent symmetric generalized problem is
+    solved instead: Cholesky-whiten S_W, then ``eigh`` — same subspace.
+    """
+
+    num_dimensions: int = static_field(default=2)
+
+    def fit(self, data, labels) -> LinearMapper:
+        x = jnp.asarray(data)
+        y = np.asarray(labels)
+        classes = np.unique(y)
+        d = x.shape[1]
+        mean_all = jnp.mean(x, axis=0)
+        s_w = jnp.zeros((d, d), x.dtype)
+        s_b = jnp.zeros((d, d), x.dtype)
+        for c in classes:
+            xc = x[np.flatnonzero(y == c)]
+            mu = jnp.mean(xc, axis=0)
+            dev = xc - mu
+            s_w = s_w + dev.T @ dev
+            dm = (mu - mean_all)[:, None]
+            s_b = s_b + xc.shape[0] * (dm @ dm.T)
+        # regularize S_W slightly for Cholesky robustness
+        s_w = s_w + 1e-6 * jnp.trace(s_w) / d * jnp.eye(d, dtype=x.dtype)
+        l = jnp.linalg.cholesky(s_w)
+        li = jax.scipy.linalg.solve_triangular(l, jnp.eye(d, dtype=x.dtype), lower=True)
+        m = li @ s_b @ li.T
+        vals, vecs = jnp.linalg.eigh(m)
+        order = jnp.argsort(-vals)[: self.num_dimensions]
+        w = li.T @ vecs[:, order]
+        return LinearMapper(x=w)

@@ -1,0 +1,6 @@
+"""Shared utilities: image batch types and math helpers (reference
+``src/main/scala/utils/``, SURVEY.md §2.9)."""
+
+from keystone_tpu.utils.images import LabeledImages, conv2d_separable, rgb_to_gray
+
+__all__ = ["LabeledImages", "conv2d_separable", "rgb_to_gray"]

@@ -1,0 +1,77 @@
+"""Image batch representation and core image math.
+
+The reference carries an ``Image`` trait with five array-layout classes
+(``utils/images/Image.scala``: ByteArray, ChannelMajor, ColumnMajor,
+RowMajor, RowColumnMajorByte) because JVM code touches pixels one at a time.
+On TPU layout belongs to XLA: a batch of images is ONE ``(N, H, W, C)``
+float array and the layout classes disappear (SURVEY.md §7.1). Per-image
+metadata is the shape.
+
+Reference quirk inherited deliberately: the reference's ``xDim`` is image
+*height* (``Image.scala`` ImageMetadata); here H is explicit so nothing is
+swapped.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+# MATLAB rgb2gray weights, as in the reference (utils/images/ImageUtils.scala
+# toGrayScale: 0.2989 R + 0.5870 G + 0.1140 B).
+GRAY_WEIGHTS = (0.2989, 0.5870, 0.1140)
+
+
+@dataclasses.dataclass
+class LabeledImages:
+    """(labels, images) bundle — reference ``LabeledImage`` batches.
+
+    ``images``: (N, H, W, C) float array; ``labels``: (N,) ints or
+    (N, k)/ragged multi-labels (VOC-style).
+    """
+
+    labels: np.ndarray
+    images: np.ndarray
+
+    def __len__(self) -> int:
+        return self.images.shape[0]
+
+
+def rgb_to_gray(images):
+    """NTSC/MATLAB grayscale, keeping a single channel
+    (reference ImageUtils.toGrayScale)."""
+    w = jnp.asarray(GRAY_WEIGHTS, images.dtype)
+    return jnp.tensordot(images, w, axes=[[-1], [0]])[..., None]
+
+
+def conv2d_separable(images, kernel_x, kernel_y):
+    """Separable 2-pass 2-D convolution with zero padding, per channel —
+    the reference's hot kernel under Daisy/LCS (ImageUtils.conv2D).
+
+    ``images``: (N, H, W, C); ``kernel_x``: (kx,) applied along W;
+    ``kernel_y``: (ky,) applied along H. Same-size output (zero-padded),
+    matching the reference's edge behavior.
+    """
+    import jax
+
+    kx = jnp.asarray(kernel_x, images.dtype)[::-1]
+    ky = jnp.asarray(kernel_y, images.dtype)[::-1]
+    n, h, w, c = images.shape
+    x = jnp.transpose(images, (0, 3, 1, 2)).reshape(n * c, 1, h, w)
+    # pass 1: along W (asymmetric pad keeps same-size output for even kernels)
+    kw = kx.reshape(1, 1, 1, -1)
+    x = jax.lax.conv_general_dilated(
+        x, kw, window_strides=(1, 1), padding=((0, 0), _pad(kx))
+    )
+    # pass 2: along H
+    kh = ky.reshape(1, 1, -1, 1)
+    x = jax.lax.conv_general_dilated(
+        x, kh, window_strides=(1, 1), padding=(_pad(ky), (0, 0))
+    )
+    return jnp.transpose(x.reshape(n, c, h, w), (0, 2, 3, 1))
+
+
+def _pad(k) -> tuple[int, int]:
+    return ((k.shape[0] - 1) // 2, k.shape[0] // 2)

@@ -1,0 +1,80 @@
+"""CIFAR pipeline E2E tests (reference LinearPixels / RandomPatchCifar)."""
+
+import os
+import tempfile
+
+import numpy as np
+
+from keystone_tpu.loaders.cifar import RECORD, load_cifar
+from keystone_tpu.models import cifar_linear_pixels as lp
+from keystone_tpu.models import cifar_random_patch as rp
+
+
+def _write_cifar_bin(path: str, n: int, seed: int = 0) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    recs = np.zeros((n, RECORD), np.uint8)
+    labels = rng.integers(0, 10, size=n)
+    recs[:, 0] = labels
+    recs[:, 1:] = rng.integers(0, 256, size=(n, RECORD - 1))
+    recs.tofile(path)
+    return labels
+
+
+def test_cifar_loader_roundtrip(tmp_path):
+    path = os.path.join(tmp_path, "batch.bin")
+    labels = _write_cifar_bin(path, 7)
+    out = load_cifar(path)
+    assert out.images.shape == (7, 32, 32, 3)
+    np.testing.assert_array_equal(out.labels, labels)
+    # plane layout: record bytes 1..1024 are the R plane row-major
+    raw = np.fromfile(path, np.uint8).reshape(7, RECORD)
+    np.testing.assert_array_equal(
+        out.images[0, :, :, 0].astype(np.uint8).ravel(), raw[0, 1:1025]
+    )
+
+
+def test_cifar_loader_rejects_bad_size(tmp_path):
+    path = os.path.join(tmp_path, "bad.bin")
+    np.zeros(100, np.uint8).tofile(path)
+    try:
+        load_cifar(path)
+        assert False, "expected ValueError"
+    except ValueError as e:
+        assert "record" in str(e)
+
+
+def test_linear_pixels_synthetic(mesh8):
+    res = lp.run(lp.LinearPixelsConfig(synthetic=200, lam=10.0), mesh=mesh8)
+    assert res["train_error"] < 0.05
+    assert res["test_error"] < 0.3
+
+
+def test_random_patch_cifar_synthetic():
+    conf = rp.RandomCifarConfig(
+        synthetic=150,
+        num_filters=16,
+        pool_size=14,
+        pool_stride=13,
+        lam=50.0,
+        block_size=512,
+        chunk_size=64,
+    )
+    res = rp.run(conf, mesh=None)
+    # synthetic classes are linearly separable; conv features keep them so
+    assert res["train_error"] < 0.1
+    assert res["test_error"] < 0.5
+    assert res["n_train"] == 150
+
+
+def test_random_patch_cifar_mesh_matches_local(mesh8):
+    conf = rp.RandomCifarConfig(
+        synthetic=160,
+        num_filters=8,
+        lam=50.0,
+        block_size=512,
+        chunk_size=80,
+        seed=1,
+    )
+    res_mesh = rp.run(conf, mesh=mesh8)
+    res_local = rp.run(conf, mesh=None)
+    assert abs(res_mesh["train_error"] - res_local["train_error"]) < 0.05

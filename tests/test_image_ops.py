@@ -1,0 +1,198 @@
+"""Image node tests (reference ConvolverSuite, PoolingSuite, WindowingSuite,
+ZCAWhiteningSuite, PCASuite — tiny hand-built inputs + property tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.ops.images import (
+    Convolver,
+    GrayScaler,
+    ImageVectorizer,
+    PixelScaler,
+    Pooler,
+    SymmetricRectifier,
+    Windower,
+    extract_patches,
+    normalize_patch_rows,
+)
+from keystone_tpu.ops.linalg import (
+    LinearDiscriminantAnalysis,
+    PCAEstimator,
+    ZCAWhitenerEstimator,
+    compute_pca,
+)
+from keystone_tpu.utils.images import conv2d_separable
+
+
+def test_gray_scaler_weights():
+    img = jnp.ones((1, 2, 2, 3)) * jnp.asarray([100.0, 200.0, 50.0])
+    out = np.asarray(GrayScaler()(img))
+    expected = 0.2989 * 100 + 0.587 * 200 + 0.114 * 50
+    np.testing.assert_allclose(out, expected, rtol=1e-5)
+    assert out.shape == (1, 2, 2, 1)
+
+
+def test_pixel_scaler():
+    np.testing.assert_allclose(
+        np.asarray(PixelScaler()(jnp.full((1, 1, 1, 3), 255.0))), 1.0
+    )
+
+
+def test_image_vectorizer_channel_fastest():
+    img = jnp.arange(12.0).reshape(1, 2, 2, 3)
+    out = np.asarray(ImageVectorizer()(img))
+    np.testing.assert_array_equal(out[0], np.arange(12.0))
+
+
+def test_extract_patches_layout(rng):
+    """Patch flattening must be (dy, dx, c) with channel fastest."""
+    img = jnp.asarray(rng.normal(size=(1, 4, 4, 2)).astype(np.float32))
+    p = np.asarray(extract_patches(img, 2))  # (1, 3, 3, 8)
+    assert p.shape == (1, 3, 3, 8)
+    im = np.asarray(img)[0]
+    # patch at (0,0): rows (dy,dx) = (0,0),(0,1),(1,0),(1,1), c fastest
+    expected = np.concatenate([im[0, 0], im[0, 1], im[1, 0], im[1, 1]])
+    np.testing.assert_allclose(p[0, 0, 0], expected, rtol=1e-6)
+
+
+def test_windower_counts_and_content(rng):
+    img = jnp.asarray(rng.normal(size=(2, 5, 5, 1)).astype(np.float32))
+    out = Windower(stride=2, window_size=3)(img)
+    assert out.shape == (2 * 4, 3, 3, 1)  # 2x2 windows per image
+    np.testing.assert_allclose(
+        np.asarray(out)[0], np.asarray(img)[0, :3, :3], rtol=1e-6
+    )
+
+
+def test_symmetric_rectifier():
+    img = jnp.asarray([[[[1.0, -2.0]]]])
+    out = np.asarray(SymmetricRectifier(alpha=0.25)(img))
+    np.testing.assert_allclose(out[0, 0, 0], [0.75, 0.0, 0.0, 1.75])
+
+
+def test_pooler_reference_geometry():
+    """27x27 input, pool 14 stride 13 → 2x2 pools; edge windows truncated."""
+    img = jnp.ones((1, 27, 27, 1))
+    out = np.asarray(Pooler(stride=13, pool_size=14)(img))
+    assert out.shape == (1, 2, 2, 1)
+    # window [0,14) full = 196; edge window [13,27) = 14 wide → also 196
+    np.testing.assert_allclose(out[0, :, :, 0], [[196, 196], [196, 196]])
+    # 34-wide: 3 pools, last window [26, 34) truncated to 8 → 14*8=112
+    img2 = jnp.ones((1, 34, 34, 1))
+    out2 = np.asarray(Pooler(stride=13, pool_size=14)(img2))
+    assert out2.shape == (1, 3, 3, 1)
+    assert abs(out2[0, 0, 0, 0] - 196) < 1e-5
+    assert abs(out2[0, 2, 2, 0] - 64) < 1e-5  # 8x8 corner
+    assert abs(out2[0, 0, 2, 0] - 112) < 1e-5  # 14x8 edge
+
+
+def test_pooler_max_and_pixel_fn():
+    img = jnp.asarray(np.arange(16.0, dtype=np.float32).reshape(1, 4, 4, 1))
+    out = np.asarray(
+        Pooler(stride=2, pool_size=2, pool_fn="max", pixel_fn=lambda x: -x)(img)
+    )
+    assert out.shape == (1, 2, 2, 1)
+    np.testing.assert_allclose(out[0, :, :, 0], [[-0.0, -2.0], [-8.0, -10.0]])
+
+
+def test_convolver_plain_matches_manual(rng):
+    """Un-normalized Convolver must equal a direct cross-correlation."""
+    img = jnp.asarray(rng.normal(size=(1, 5, 5, 2)).astype(np.float32))
+    filt = rng.normal(size=(3, 2 * 2 * 2)).astype(np.float32)
+    conv = Convolver(
+        filters=jnp.asarray(filt), patch_size=2, normalize_patches=False
+    )
+    out = np.asarray(conv(img))  # (1, 4, 4, 3)
+    p = np.asarray(extract_patches(img, 2))
+    expected = p @ filt.T
+    np.testing.assert_allclose(out, expected, rtol=1e-4)
+
+
+def test_normalize_patch_rows_matches_reference_formula(rng):
+    m = rng.normal(size=(5, 8)).astype(np.float32) * 3
+    out = np.asarray(normalize_patch_rows(jnp.asarray(m), 10.0))
+    mean = m.mean(1, keepdims=True)
+    var = ((m - mean) ** 2).sum(1, keepdims=True) / (8 - 1)
+    np.testing.assert_allclose(out, (m - mean) / np.sqrt(var + 10.0), rtol=1e-5)
+
+
+def test_zca_whitened_covariance_near_identity(rng):
+    """Whitened covariance ≈ I when eigenvalues dominate the 0.1 floor
+    (reference ZCAWhiteningSuite)."""
+    base = rng.normal(size=(2000, 6)).astype(np.float32) * 10
+    mix = np.eye(6, dtype=np.float32) + 0.3 * rng.normal(size=(6, 6)).astype(
+        np.float32
+    )
+    x = base @ mix  # correlated, all eigenvalues >> 0.1
+    w = ZCAWhitenerEstimator().fit(jnp.asarray(x))
+    out = np.asarray(w(jnp.asarray(x)))
+    cov = out.T @ out / (out.shape[0] - 1)
+    np.testing.assert_allclose(cov, np.eye(6), atol=0.06)
+
+
+def test_zca_matches_reference_formula(rng):
+    """W must equal V diag((s²/(n−1)+0.1)^-½) Vᵀ of the centered sample."""
+    x = (rng.normal(size=(50, 4)) * 3).astype(np.float32)
+    w = ZCAWhitenerEstimator().fit(jnp.asarray(x))
+    xc = x - x.mean(0)
+    _, s, vt = np.linalg.svd(xc, full_matrices=False)
+    expected = (vt.T * (s * s / (len(x) - 1) + 0.1) ** -0.5) @ vt
+    np.testing.assert_allclose(np.asarray(w.whitener), expected, atol=1e-4)
+
+
+def test_pca_projection_decorrelates(rng):
+    """Projected covariance off-diagonals ≈ 0 (reference PCASuite)."""
+    base = rng.normal(size=(500, 4)).astype(np.float32)
+    mix = rng.normal(size=(4, 8)).astype(np.float32)
+    x = base @ mix
+    pca = PCAEstimator(dims=4).fit(jnp.asarray(x))
+    out = np.array(pca(jnp.asarray(x)))
+    out -= out.mean(0)
+    cov = out.T @ out / (out.shape[0] - 1)
+    offdiag = cov - np.diag(np.diag(cov))
+    assert np.abs(offdiag).max() < 1e-2 * cov.max()
+
+
+def test_pca_sign_convention(rng):
+    x = rng.normal(size=(100, 5)).astype(np.float32)
+    mat = np.asarray(compute_pca(jnp.asarray(x), 5))
+    # each column's largest-|.| element is positive
+    for j in range(5):
+        col = mat[:, j]
+        assert col[np.abs(col).argmax()] > 0
+
+
+def test_lda_separates_iris_like(rng):
+    """LDA on 3 gaussian classes: projected class means well separated."""
+    n = 150
+    labels = np.repeat(np.arange(3), n // 3)
+    centers = np.asarray([[0, 0, 0, 0], [4, 0, 2, 0], [0, 4, 0, 2]], np.float32)
+    x = centers[labels] + rng.normal(size=(n, 4)).astype(np.float32) * 0.5
+    lda = LinearDiscriminantAnalysis(num_dimensions=2).fit(
+        jnp.asarray(x), labels
+    )
+    proj = np.asarray(lda(jnp.asarray(x)))
+    mus = np.stack([proj[labels == c].mean(0) for c in range(3)])
+    within = np.mean([proj[labels == c].std(0).mean() for c in range(3)])
+    dists = [np.linalg.norm(mus[i] - mus[j]) for i in range(3) for j in range(i)]
+    assert min(dists) > 3 * within
+
+
+def test_conv2d_separable_matches_direct(rng):
+    img = jnp.asarray(rng.normal(size=(1, 6, 6, 1)).astype(np.float32))
+    kx = np.asarray([1.0, 0.0, -1.0], np.float32)
+    ky = np.asarray([1.0, 2.0, 1.0], np.float32)
+    out = np.asarray(conv2d_separable(img, kx, ky))[0, :, :, 0]
+    im = np.asarray(img)[0, :, :, 0]
+    padded = np.pad(im, 1)
+    expected = np.zeros_like(im)
+    for i in range(6):
+        for j in range(6):
+            acc = 0.0
+            # true convolution (reference reverses the filters, conv2D)
+            for di in range(3):
+                for dj in range(3):
+                    acc += padded[i + di, j + dj] * ky[2 - di] * kx[2 - dj]
+            expected[i, j] = acc
+    np.testing.assert_allclose(out, expected, atol=1e-4)
