@@ -1,0 +1,119 @@
+"""Shared descriptor→Fisher-vector branch used by the VOC and ImageNet
+pipelines (reference VOCSIFTFisher / ImageNetSiftLcsFV both build
+SIFT/LCS → PCA → GMM → FV → vectorize → normalize → hellinger → normalize
+chains with per-branch fitted PCA/GMM)."""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.core.batching import apply_in_chunks
+from keystone_tpu.core.logging import get_logger
+from keystone_tpu.ops.gmm import (
+    FisherVector,
+    GaussianMixtureModel,
+    GaussianMixtureModelEstimator,
+)
+from keystone_tpu.ops.linalg import BatchPCATransformer, compute_pca
+from keystone_tpu.ops.stats import NormalizeRows, SignedHellingerMapper
+from keystone_tpu.ops.util import MatrixVectorizer
+
+logger = get_logger("keystone_tpu.models.fisher_common")
+
+
+def sample_descriptor_columns(desc, num: int, seed: int) -> jnp.ndarray:
+    """(N, d, m) → (≤num, d) rows sampled across all columns (the
+    reference's ColumnSampler feeding PCA/GMM fits)."""
+    n, d, m = desc.shape
+    flat = jnp.transpose(desc, (0, 2, 1)).reshape(n * m, d)
+    total = flat.shape[0]
+    if total > num:
+        idx = np.sort(
+            np.random.default_rng(seed).choice(total, num, replace=False)
+        )
+        flat = jnp.take(flat, jnp.asarray(idx), axis=0)
+    return flat
+
+
+class FisherBranch:
+    """Fit (or load) PCA + GMM on a descriptor family and featurize batches
+    of (N, d, m) descriptors into normalized Fisher vectors."""
+
+    def __init__(
+        self,
+        desc_dim: int,
+        vocab_size: int,
+        num_pca_samples: int,
+        num_gmm_samples: int,
+        seed: int,
+        pca_file: str = "",
+        gmm_files: tuple[str, str, str] = ("", "", ""),
+    ):
+        self.desc_dim = desc_dim
+        self.vocab_size = vocab_size
+        self.num_pca_samples = num_pca_samples
+        self.num_gmm_samples = num_gmm_samples
+        self.seed = seed
+        self.pca_file = pca_file
+        self.gmm_files = gmm_files
+        self.pca: BatchPCATransformer | None = None
+        self.post = None
+
+    def fit(self, train_desc, chunk_size: int):
+        """Fit PCA/GMM (artifact-aware) and return the projected train
+        descriptors (reused by featurize of the training set)."""
+        if self.pca_file and os.path.exists(self.pca_file):
+            pca_mat = jnp.asarray(
+                np.loadtxt(self.pca_file, delimiter=",", ndmin=2), jnp.float32
+            )
+            logger.info("loaded PCA from %s", self.pca_file)
+        else:
+            sample = sample_descriptor_columns(
+                train_desc, self.num_pca_samples, self.seed
+            )
+            pca_mat = compute_pca(sample, self.desc_dim)
+            if self.pca_file:
+                np.savetxt(self.pca_file, np.asarray(pca_mat), delimiter=",")
+        self.pca = BatchPCATransformer(pca_mat=pca_mat)
+
+        projected = apply_in_chunks(
+            jax.jit(lambda d, p=self.pca: p(d)), train_desc, chunk_size
+        )
+
+        if all(self.gmm_files) and all(
+            os.path.exists(f) for f in self.gmm_files
+        ):
+            gmm = GaussianMixtureModel.load_csv(*self.gmm_files)
+            logger.info("loaded GMM from %s", self.gmm_files[0])
+        else:
+            sample = sample_descriptor_columns(
+                projected, self.num_gmm_samples, self.seed + 1
+            )
+            gmm = GaussianMixtureModelEstimator(k=self.vocab_size).fit(sample)
+            if all(self.gmm_files):
+                gmm.save_csv(*self.gmm_files)
+
+        self.post = (
+            FisherVector(gmm=gmm)
+            >> MatrixVectorizer()
+            >> NormalizeRows()
+            >> SignedHellingerMapper()
+            >> NormalizeRows()
+        )
+        return projected
+
+    def featurize_projected(self, projected, chunk_size: int):
+        fn = jax.jit(lambda p, d: p(d))
+        return apply_in_chunks(
+            lambda d: fn(self.post, d), projected, chunk_size
+        )
+
+    def featurize(self, desc, chunk_size: int):
+        projected = apply_in_chunks(
+            jax.jit(lambda d, p=self.pca: p(d)), desc, chunk_size
+        )
+        return self.featurize_projected(projected, chunk_size)

@@ -1,0 +1,172 @@
+"""PASCAL VOC SIFT + Fisher Vector pipeline
+(reference ``pipelines/images/voc/VOCSIFTFisher.scala``).
+
+Stages: pixel-scale → grayscale → dense SIFT → PCA projection (fit on
+sampled descriptor columns, or loaded from a CSV artifact) → GMM (fit on
+sampled projected descriptors, or loaded) → Fisher vectors → vectorize →
+L2-normalize → signed-sqrt → L2-normalize → block least squares on ±1
+multi-label indicators → mean average precision.
+
+The reference's "cache expensive fitted stages to disk, reload by flag"
+capability (SURVEY.md §5 checkpoint/resume) is preserved: PCA/GMM artifacts
+save/load as CSVs compatible with the reference's file formats.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.core.batching import apply_in_chunks
+from keystone_tpu.core.config import arg, parse_config
+from keystone_tpu.core.logging import get_logger
+from keystone_tpu.evaluation import MeanAveragePrecisionEvaluator
+from keystone_tpu.loaders.image_loaders import VOC_NUM_CLASSES, load_voc
+from keystone_tpu.models.fisher_common import FisherBranch
+from keystone_tpu.ops.images import GrayScaler, PixelScaler
+from keystone_tpu.ops.linear import BlockLeastSquaresEstimator
+from keystone_tpu.ops.sift import SIFTExtractor
+from keystone_tpu.ops.util import ClassLabelIndicators
+from keystone_tpu.parallel.mesh import create_mesh, shard_batch
+from keystone_tpu.utils.images import LabeledImages
+
+logger = get_logger("keystone_tpu.models.voc_sift_fisher")
+
+
+@dataclasses.dataclass
+class VOCConfig:
+    """VOC SIFT/Fisher workload (reference SIFTFisherConfig defaults:
+    descDim 80, vocabSize 256, 1e6 PCA/GMM samples)."""
+
+    train_location: str = arg(default="", help="train tar file/dir/glob")
+    train_labels: str = arg(default="", help="train multi-label csv")
+    test_location: str = arg(default="", help="test tar file/dir/glob")
+    test_labels: str = arg(default="", help="test multi-label csv")
+    desc_dim: int = arg(default=80, help="PCA output dim")
+    vocab_size: int = arg(default=256, help="GMM centroids")
+    num_pca_samples: int = arg(default=1_000_000)
+    num_gmm_samples: int = arg(default=1_000_000)
+    lam: float = arg(default=0.5)
+    block_size: int = arg(default=4096)
+    chunk_size: int = arg(default=64, help="images per featurize chunk")
+    image_size: int = arg(default=256)
+    sift_scales: int = arg(default=5)
+    seed: int = arg(default=0)
+    pca_file: str = arg(default="", help="load/save PCA matrix csv")
+    gmm_mean_file: str = arg(default="")
+    gmm_var_file: str = arg(default="")
+    gmm_wt_file: str = arg(default="")
+    synthetic: int = arg(default=0, help="if > 0, N synthetic images")
+
+
+def _load(conf: VOCConfig, which: str) -> LabeledImages:
+    if conf.synthetic:
+        n = conf.synthetic if which == "train" else max(conf.synthetic // 4, 1)
+        rng = np.random.default_rng(0 if which == "train" else 1)
+        centers = np.random.default_rng(42).normal(
+            loc=128, scale=30, size=(VOC_NUM_CLASSES, 8, 8, 3)
+        )
+        labels = -np.ones((n, 2), np.int32)
+        labels[:, 0] = rng.integers(0, VOC_NUM_CLASSES, size=n)
+        # upsample class-pattern to image size so SIFT sees class structure
+        base = centers[labels[:, 0]]
+        imgs = np.kron(
+            base, np.ones((1, conf.image_size // 8, conf.image_size // 8, 1))
+        )
+        imgs += rng.normal(scale=20, size=imgs.shape)
+        return LabeledImages(
+            labels=labels, images=np.clip(imgs, 0, 255).astype(np.float32)
+        )
+    if which == "train":
+        return load_voc(
+            conf.train_location, conf.train_labels, target_size=conf.image_size
+        )
+    return load_voc(
+        conf.test_location, conf.test_labels, target_size=conf.image_size
+    )
+
+
+def run(conf: VOCConfig, mesh=None) -> dict:
+    if mesh is None and len(jax.devices()) > 1:
+        mesh = create_mesh()
+    t0 = time.perf_counter()
+    train = _load(conf, "train")
+    test = _load(conf, "test")
+    n_train, n_test = len(train), len(test)
+
+    gray = PixelScaler() >> GrayScaler()
+    sift = SIFTExtractor(num_scales=conf.sift_scales)
+    gray_sift = jax.jit(lambda b: sift(gray(b)))
+
+    branch = FisherBranch(
+        conf.desc_dim,
+        conf.vocab_size,
+        conf.num_pca_samples,
+        conf.num_gmm_samples,
+        conf.seed,
+        pca_file=conf.pca_file,
+        gmm_files=(conf.gmm_mean_file, conf.gmm_var_file, conf.gmm_wt_file),
+    )
+    train_imgs = shard_batch(train.images, mesh)
+    sift_train = apply_in_chunks(gray_sift, train_imgs, conf.chunk_size)
+    pca_train = branch.fit(sift_train, conf.chunk_size)
+    f_train = branch.featurize_projected(pca_train, conf.chunk_size)
+    t_feat = time.perf_counter()
+
+    y = -np.ones((f_train.shape[0], train.labels.shape[1]), np.int32)
+    y[:n_train] = train.labels
+    indicators = ClassLabelIndicators(num_classes=VOC_NUM_CLASSES)(
+        jnp.asarray(y)
+    )
+    model = BlockLeastSquaresEstimator(
+        block_size=conf.block_size, num_iter=1, lam=conf.lam
+    ).fit(f_train, indicators, n_valid=n_train)
+    t_fit = time.perf_counter()
+
+    def featurize_test(images):
+        x = shard_batch(images, mesh)
+        s = apply_in_chunks(gray_sift, x, conf.chunk_size)
+        return branch.featurize(s, conf.chunk_size)
+
+    evaluator = MeanAveragePrecisionEvaluator(VOC_NUM_CLASSES)
+    test_scores = model(featurize_test(test.images))
+    y_test = ClassLabelIndicators(num_classes=VOC_NUM_CLASSES)(
+        jnp.asarray(test.labels)
+    )
+    aps = evaluator(np.asarray(y_test), np.asarray(test_scores)[:n_test])
+    train_scores = model(f_train)
+    train_aps = evaluator(
+        np.asarray(indicators)[:n_train], np.asarray(train_scores)[:n_train]
+    )
+
+    result = {
+        "test_map": float(aps.mean()),
+        "train_map": float(train_aps.mean()),
+        "n_train": n_train,
+        "n_test": n_test,
+        "featurize_s": t_feat - t0,
+        "fit_s": t_fit - t_feat,
+        "total_s": time.perf_counter() - t0,
+    }
+    logger.info(
+        "VOCSIFTFisher: train MAP %.4f, test MAP %.4f", result["train_map"], result["test_map"]
+    )
+    return result
+
+
+def main(argv=None) -> dict:
+    conf = parse_config(VOCConfig, argv)
+    if not conf.synthetic and not (conf.train_location and conf.train_labels):
+        raise SystemExit(
+            "need --train-location/--train-labels (+ test), or --synthetic N"
+        )
+    return run(conf)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+"""Local Color Statistics extractor (reference nodes/images/LCSExtractor.scala).
+
+Per keypoint on a regular grid: a 4×4 neighborhood of sub-patches, each
+described by the mean and standard deviation of every color channel →
+96-dim descriptors (4·4·3·2) for RGB. Mean/std maps come from one separable
+box filter over the whole batch (the reference's conv2D with a ones
+vector), then descriptors are pure gathers — all one jitted program.
+
+Output layout parity: feature-major (N, 96, num_keypoints); feature order
+(channel, nx, ny, {mean, std}) and column order row-major over the keypoint
+grid, matching the reference's packing.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.core.pipeline import Transformer
+from keystone_tpu.core.treenode import static_field, treenode
+from keystone_tpu.utils.images import conv2d_separable
+
+
+@treenode
+class LCSExtractor(Transformer):
+    """(N, H, W, C) → (N, C·4·4·2, num_keypoints).
+
+    Reference defaults (ImageNetSiftLcsFV): stride 4, strideStart 16,
+    subPatchSize 6.
+    """
+
+    stride: int = static_field(default=4)
+    stride_start: int = static_field(default=16)
+    sub_patch_size: int = static_field(default=6)
+
+    def __call__(self, batch):
+        return _lcs(
+            batch, self.stride, self.stride_start, self.sub_patch_size
+        )
+
+
+@partial(jax.jit, static_argnames=("stride", "stride_start", "sps"))
+def _lcs(batch, stride: int, stride_start: int, sps: int):
+    n, h, w, c = batch.shape
+    box = np.full(sps, 1.0 / sps, np.float32)
+    means = conv2d_separable(batch, box, box)
+    sq = conv2d_separable(batch * batch, box, box)
+    stds = jnp.sqrt(jnp.maximum(sq - means * means, 0.0))
+
+    # keypoint grid: strideStart until dim − strideStart by stride
+    kp_rows = np.arange(stride_start, h - stride_start, stride)
+    kp_cols = np.arange(stride_start, w - stride_start, stride)
+    # neighborhood offsets: −2·sps + sps/2 − 1 .. sps + sps/2 − 1 by sps
+    offs = np.arange(-2 * sps + sps // 2 - 1, sps + sps // 2, sps)
+
+    row_idx = jnp.asarray((kp_rows[:, None] + offs[None, :]).reshape(-1))
+    col_idx = jnp.asarray((kp_cols[:, None] + offs[None, :]).reshape(-1))
+
+    def gather(img):
+        g = jnp.take(img, row_idx, axis=1)
+        g = jnp.take(g, col_idx, axis=2)
+        return g.reshape(n, len(kp_rows), len(offs), len(kp_cols), len(offs), c)
+
+    gm = gather(means)  # (N, kr, nx, kc, ny, C)
+    gs = gather(stds)
+    both = jnp.stack([gm, gs], axis=-1)  # (N, kr, nx, kc, ny, C, 2)
+    # → features ordered (C, nx, ny, stat); columns row-major over (kr, kc)
+    both = jnp.transpose(both, (0, 1, 3, 5, 2, 4, 6))
+    n_kp = len(kp_rows) * len(kp_cols)
+    feats = both.reshape(n, n_kp, c * len(offs) * len(offs) * 2)
+    return jnp.transpose(feats, (0, 2, 1))

@@ -1,0 +1,169 @@
+"""Dense multi-scale SIFT on-device.
+
+TPU-native replacement for the reference's native VLFeat JNI component
+(``src/main/cpp/VLFeat.cxx`` over vl_dsift; SURVEY.md §2.10). Shim-parity
+structure:
+
+- scales: bin sizes ``bin + 2·s`` for s = 0..num_scales−1,
+- per scale the image is gaussian-smoothed with ``sigma = bin_s / 6``
+  (magnif 6), gradients → 8 soft-binned orientation planes, 4×4 spatial
+  bins of size ``bin_s``,
+- keypoint grid starts at ``off = (1 + 2·num_scales) − 3·s`` with the given
+  step (the shim's bounding-box trick),
+- descriptors L2-normalized, clamped at 0.2, renormalized (standard SIFT),
+- low-contrast descriptors (pre-normalization norm < 0.005) zeroed — the
+  shim's contrast-threshold zeroing,
+- quantized ``min(512·v, 255)`` like the shim's short output.
+
+Everything is one jitted program of convolutions and gathers — no host
+round-trip per image, unlike the JNI-per-image reference path. The spatial
+weighting uses bilinear (triangular) binning, vl_dsift's exact-SIFT mode
+(the shim enables the flat-window *approximation* for speed; bit-exact
+parity with vl_phow goldens is a known gap tracked for a later round).
+
+Output layout matches ``SIFTExtractor.scala``: per image a feature-major
+(128, num_descriptors) matrix, batched to (N, 128, M).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.core.pipeline import Transformer
+from keystone_tpu.core.treenode import static_field, treenode
+from keystone_tpu.utils.images import conv2d_separable
+
+NUM_ORIENTATIONS = 8
+NUM_SPATIAL_BINS = 4
+DESC_DIM = NUM_ORIENTATIONS * NUM_SPATIAL_BINS * NUM_SPATIAL_BINS  # 128
+CONTRAST_THRESHOLD = 0.005
+
+
+def gaussian_kernel(sigma: float) -> np.ndarray:
+    radius = max(int(math.ceil(4.0 * sigma)), 1)
+    x = np.arange(-radius, radius + 1, dtype=np.float32)
+    k = np.exp(-0.5 * (x / max(sigma, 1e-8)) ** 2)
+    return k / k.sum()
+
+
+def _smooth_edge_padded(img, k: np.ndarray):
+    """Gaussian smooth with edge replication (vl_imsmooth behavior) — plain
+    zero padding would manufacture gradients at the borders."""
+    r = (len(k) - 1) // 2
+    padded = jnp.pad(img, ((0, 0), (r, r), (r, r)), mode="edge")
+    out = conv2d_separable(padded[..., None], k, k)[..., 0]
+    return out[:, r:-r, r:-r] if r else out
+
+
+def _orientation_planes(img):
+    """(N, H, W) → (N, H, W, 8) soft-binned gradient magnitude planes."""
+    gy = jnp.pad(img[:, 2:, :] - img[:, :-2, :], ((0, 0), (1, 1), (0, 0))) * 0.5
+    gx = jnp.pad(img[:, :, 2:] - img[:, :, :-2], ((0, 0), (0, 0), (1, 1))) * 0.5
+    mag = jnp.sqrt(gx * gx + gy * gy)
+    angle = jnp.arctan2(gy, gx)  # [-pi, pi]
+    t = angle / (2 * jnp.pi / NUM_ORIENTATIONS)  # in bins
+    t = jnp.mod(t, NUM_ORIENTATIONS)
+    lo = jnp.floor(t)
+    frac = t - lo
+    lo = lo.astype(jnp.int32) % NUM_ORIENTATIONS
+    hi = (lo + 1) % NUM_ORIENTATIONS
+    onehot_lo = jax.nn.one_hot(lo, NUM_ORIENTATIONS, dtype=img.dtype)
+    onehot_hi = jax.nn.one_hot(hi, NUM_ORIENTATIONS, dtype=img.dtype)
+    return (
+        onehot_lo * (mag * (1 - frac))[..., None]
+        + onehot_hi * (mag * frac)[..., None]
+    )
+
+
+def _scale_descriptors(img, bin_size: int, step: int, offset: int):
+    """Descriptors for one scale. img: (N, H, W) already smoothed.
+
+    Returns (N, num_kp, 128) unnormalized histograms.
+    """
+    n, h, w = img.shape
+    planes = _orientation_planes(img)  # (N, H, W, 8)
+    # triangular spatial window of half-width bin_size (exact-SIFT mode)
+    tri = np.maximum(
+        0.0, 1.0 - np.abs(np.arange(-bin_size + 1, bin_size)) / bin_size
+    ).astype(np.float32)
+    acc = conv2d_separable(planes, tri, tri)  # (N, H, W, 8)
+
+    support = NUM_SPATIAL_BINS * bin_size
+    # bin centers relative to descriptor corner (rounded to pixels)
+    centers = (np.arange(NUM_SPATIAL_BINS) * bin_size + (bin_size - 1) / 2.0)
+    centers = np.round(centers).astype(np.int32)
+    max_corner_y = h - support
+    max_corner_x = w - support
+    ys0 = np.arange(offset, max_corner_y + 1, step, dtype=np.int32)
+    xs0 = np.arange(offset, max_corner_x + 1, step, dtype=np.int32)
+    if len(ys0) == 0 or len(xs0) == 0:
+        return jnp.zeros((n, 0, DESC_DIM), img.dtype)
+
+    row_idx = (ys0[:, None] + centers[None, :]).reshape(-1)  # (ky*4,)
+    col_idx = (xs0[:, None] + centers[None, :]).reshape(-1)  # (kx*4,)
+    g = jnp.take(acc, jnp.asarray(row_idx), axis=1)
+    g = jnp.take(g, jnp.asarray(col_idx), axis=2)
+    # (N, ky, 4, kx, 4, 8) → (N, ky, kx, 4, 4, 8)
+    g = g.reshape(n, len(ys0), NUM_SPATIAL_BINS, len(xs0), NUM_SPATIAL_BINS, NUM_ORIENTATIONS)
+    g = jnp.transpose(g, (0, 1, 3, 2, 4, 5))
+    return g.reshape(n, len(ys0) * len(xs0), DESC_DIM)
+
+
+def _finalize(desc):
+    """SIFT normalization: L2 → clamp 0.2 → re-L2 → quantize min(512v, 255);
+    zero out low-contrast descriptors (pre-norm norm < 0.005)."""
+    norm = jnp.linalg.norm(desc, axis=-1, keepdims=True)
+    d = desc / jnp.maximum(norm, 1e-10)
+    d = jnp.minimum(d, 0.2)
+    d = d / jnp.maximum(jnp.linalg.norm(d, axis=-1, keepdims=True), 1e-10)
+    d = jnp.minimum(jnp.floor(512.0 * d), 255.0)
+    return jnp.where(norm >= CONTRAST_THRESHOLD, d, 0.0)
+
+
+@treenode
+class SIFTExtractor(Transformer):
+    """Multi-scale dense SIFT (reference external.SIFTExtractor defaults:
+    step 3, bin 4, 5 scales, scale_step 0).
+
+    Input: (N, H, W) or (N, H, W, 1) grayscale in [0, 1].
+    Output: (N, 128, M) quantized descriptors, scales concatenated in order
+    (the shim's no-grouping concat path).
+    """
+
+    step: int = static_field(default=3)
+    bin_size: int = static_field(default=4)
+    num_scales: int = static_field(default=5)
+    scale_step: int = static_field(default=0)
+
+    def __call__(self, batch):
+        if batch.ndim == 4:
+            batch = batch[..., 0]
+        return _sift_multiscale(
+            batch, self.step, self.bin_size, self.num_scales, self.scale_step
+        )
+
+
+@partial(
+    jax.jit, static_argnames=("step", "bin_size", "num_scales", "scale_step")
+)
+def _sift_multiscale(
+    img, step: int, bin_size: int, num_scales: int, scale_step: int
+):
+    outs = []
+    for s in range(num_scales):
+        bin_s = bin_size + 2 * s
+        sigma = bin_s / 6.0
+        k = gaussian_kernel(sigma)
+        smoothed = _smooth_edge_padded(img, k)
+        offset = max((1 + 2 * num_scales) - 3 * s, 0)
+        desc = _scale_descriptors(
+            smoothed, bin_s, step + s * scale_step, offset
+        )
+        outs.append(_finalize(desc))
+    all_desc = jnp.concatenate(outs, axis=1)  # (N, M, 128)
+    return jnp.transpose(all_desc, (0, 2, 1))  # (N, 128, M)

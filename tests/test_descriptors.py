@@ -1,0 +1,116 @@
+"""Descriptor extractor tests: SIFT, LCS, DAISY, HOG (reference
+DaisyExtractorSuite / HogExtractorSuite / LCSExtractorSuite /
+VLFeatSuite-style dimension + property checks)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.ops.daisy import DaisyExtractor
+from keystone_tpu.ops.hog import HogExtractor
+from keystone_tpu.ops.lcs import LCSExtractor
+from keystone_tpu.ops.sift import SIFTExtractor
+
+
+def _texture_image(rng, h=64, w=64):
+    img = rng.random((1, h, w)).astype(np.float32)
+    # add structure: gradient + sinusoid
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    img += 0.5 * np.sin(xx / 4) + yy / h
+    return jnp.asarray(img / img.max())
+
+
+def test_sift_shapes_and_range(rng):
+    img = _texture_image(rng)
+    out = np.asarray(SIFTExtractor(num_scales=3)(img))
+    assert out.shape[0] == 1 and out.shape[1] == 128
+    assert out.shape[2] > 0
+    assert out.min() >= 0 and out.max() <= 255
+    assert out.max() > 0  # textured image produces non-zero descriptors
+    # integer quantization
+    assert np.allclose(out, np.round(out))
+
+
+def test_sift_flat_image_is_all_zero(rng):
+    """Uniform image → every descriptor below the contrast threshold → 0
+    (the shim's contrast zeroing)."""
+    img = jnp.full((1, 48, 48), 0.5, jnp.float32)
+    out = np.asarray(SIFTExtractor(num_scales=2)(img))
+    np.testing.assert_array_equal(out, 0.0)
+
+
+def test_sift_descriptor_count_formula():
+    h = w = 64
+    ext = SIFTExtractor(step=3, bin_size=4, num_scales=2)
+    out = np.asarray(ext(jnp.zeros((1, h, w), jnp.float32)))
+    total = 0
+    for s in range(2):
+        bin_s = 4 + 2 * s
+        off = (1 + 2 * 2) - 3 * s
+        support = 4 * bin_s
+        ks = len(range(off, h - support + 1, 3))
+        total += ks * ks
+    assert out.shape == (1, 128, total)
+
+
+def test_sift_vertical_edge_orientation(rng):
+    """A vertical step edge concentrates energy in the horizontal-gradient
+    orientation bins (0 or 4 = ±x)."""
+    img = np.zeros((1, 48, 48), np.float32)
+    img[:, :, 24:] = 1.0
+    out = np.asarray(SIFTExtractor(num_scales=1)(jnp.asarray(img)))
+    desc = out[0].reshape(128, -1).sum(axis=1).reshape(4, 4, 8)
+    by_orientation = desc.sum(axis=(0, 1))
+    assert by_orientation.argmax() in (0, 4)
+
+
+def test_lcs_shapes_and_constant_image(rng):
+    ext = LCSExtractor(stride=4, stride_start=16, sub_patch_size=6)
+    img = jnp.full((2, 64, 64, 3), 0.7, jnp.float32)
+    out = np.asarray(ext(img))
+    n_kp = len(range(16, 64 - 16, 4)) ** 2
+    assert out.shape == (2, 96, n_kp)
+    # constant image: means == 0.7 (interior), stds == 0
+    means = out[:, 0::2, :]
+    stds = out[:, 1::2, :]
+    np.testing.assert_allclose(means, 0.7, atol=1e-4)
+    np.testing.assert_allclose(stds, 0.0, atol=1e-3)
+
+
+def test_lcs_mean_std_values(rng):
+    img = jnp.asarray(rng.random((1, 64, 64, 3)).astype(np.float32))
+    out = np.asarray(LCSExtractor()(img))
+    assert np.isfinite(out).all()
+    assert (out[:, 1::2, :] >= 0).all()  # stds non-negative
+
+
+def test_daisy_shape_and_normalization(rng):
+    ext = DaisyExtractor()
+    img = _texture_image(rng)
+    out = np.asarray(ext(img))
+    n_kp = len(range(16, 64 - 16, 4)) ** 2
+    assert out.shape == (1, n_kp, ext.feature_size)
+    # each 8-bin histogram is L2-normalized (or zero)
+    hists = out.reshape(1, n_kp, -1, 8)
+    norms = np.linalg.norm(hists, axis=-1)
+    assert ((np.abs(norms - 1) < 1e-3) | (norms < 1e-6)).all()
+
+
+def test_hog_shape_and_properties(rng):
+    img = jnp.asarray(rng.random((2, 40, 40, 3)).astype(np.float32))
+    out = np.asarray(HogExtractor(cell_size=8)(img))
+    assert out.shape == (2, 5, 5, 31)
+    assert np.isfinite(out).all()
+    assert out.min() >= -1e-6  # all HOG features non-negative
+    # flat image → all zeros
+    flat = np.asarray(HogExtractor(cell_size=8)(jnp.full((1, 40, 40, 3), 0.5)))
+    np.testing.assert_allclose(flat, 0.0, atol=1e-6)
+
+
+def test_hog_edge_orientation_sensitivity():
+    """Vertical vs horizontal edges must excite different orientation bins."""
+    v = np.zeros((1, 40, 40, 3), np.float32)
+    v[:, :, 20:] = 1.0
+    h = np.transpose(v, (0, 2, 1, 3))
+    hv = np.asarray(HogExtractor(cell_size=8)(jnp.asarray(v)))[0, 2, 2, 18:27]
+    hh = np.asarray(HogExtractor(cell_size=8)(jnp.asarray(h)))[0, 2, 2, 18:27]
+    assert hv.argmax() != hh.argmax()
