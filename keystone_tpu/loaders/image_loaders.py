@@ -53,16 +53,55 @@ def _iter_tar_images(tar_path: str):
 
 
 def load_tar_images(
-    paths: list[str], target_size: int | None = 256, workers: int = 8
+    paths: list[str],
+    target_size: int | None = 256,
+    workers: int = 8,
+    decode_batch: int = 512,
 ) -> tuple[list[str], np.ndarray]:
-    """All images from the given tar files → (names, (N, S, S, 3) array)."""
-    raw: list[tuple[str, bytes]] = []
-    for p in paths:
-        raw.extend(_iter_tar_images(p))
-    names = [n for n, _ in raw]
+    """All images from the given tar files → (names, (N, S, S, 3) array).
+
+    Decoding streams in ``decode_batch``-sized groups so raw compressed
+    bytes are dropped as soon as each group is decoded (peak host memory is
+    pixels + one group of bytes, not the whole corpus's bytes).
+    """
+
+    def try_decode(nd):
+        # undecodable entries are skipped with a warning, like the
+        # reference's ImageUtils.loadImage failure filter
+        try:
+            return decode_image(nd[1], target_size)
+        except Exception as e:  # noqa: BLE001 — PIL raises various types
+            _logger().warning("failed to decode %s: %s", nd[0], e)
+            return None
+
+    names: list[str] = []
+    imgs: list[np.ndarray] = []
     with concurrent.futures.ThreadPoolExecutor(workers) as ex:
-        imgs = list(ex.map(lambda nd: decode_image(nd[1], target_size), raw))
+        batch: list[tuple[str, bytes]] = []
+
+        def flush():
+            nonlocal batch
+            decoded = list(ex.map(try_decode, batch))
+            for (n, _), img in zip(batch, decoded):
+                if img is not None:
+                    names.append(n)
+                    imgs.append(img)
+            batch = []
+
+        for p in paths:
+            for item in _iter_tar_images(p):
+                batch.append(item)
+                if len(batch) >= decode_batch:
+                    flush()
+        if batch:
+            flush()
     return names, np.stack(imgs) if imgs else np.zeros((0, 0, 0, 3), np.float32)
+
+
+def _logger():
+    from keystone_tpu.core.logging import get_logger
+
+    return get_logger("keystone_tpu.loaders.image_loaders")
 
 
 def _expand(path: str, suffix: str) -> list[str]:
@@ -125,4 +164,12 @@ def load_imagenet(
         return class_map.get(parent, -1)
 
     labels = np.asarray([label_of(n) for n in names], np.int32)
+    unmapped = labels < 0
+    if unmapped.any():
+        # keep unmapped images out of training entirely — a -1 label would
+        # otherwise wrap to the last class in the indicator scatter
+        _logger().warning(
+            "dropping %d images with no class-map entry", int(unmapped.sum())
+        )
+        labels, images = labels[~unmapped], images[~unmapped]
     return LabeledImages(labels=labels, images=images)

@@ -1,0 +1,62 @@
+"""20-Newsgroups loader (reference loaders/NewsgroupsDataLoader.scala):
+one directory per class (hardcoded class list), one text file per post."""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import os
+
+import numpy as np
+
+# Reference class order (NewsgroupsDataLoader.classes) — label ids depend on it.
+CLASSES = (
+    "comp.graphics",
+    "comp.os.ms-windows.misc",
+    "comp.sys.ibm.pc.hardware",
+    "comp.sys.mac.hardware",
+    "comp.windows.x",
+    "rec.autos",
+    "rec.motorcycles",
+    "rec.sport.baseball",
+    "rec.sport.hockey",
+    "sci.crypt",
+    "sci.electronics",
+    "sci.med",
+    "sci.space",
+    "misc.forsale",
+    "talk.politics.misc",
+    "talk.politics.guns",
+    "talk.politics.mideast",
+    "talk.religion.misc",
+    "alt.atheism",
+    "soc.religion.christian",
+)
+
+
+@dataclasses.dataclass
+class TextData:
+    labels: np.ndarray  # (N,) int32
+    data: list  # list of document strings
+
+    def __len__(self):
+        return len(self.data)
+
+
+def load_newsgroups(path: str) -> TextData:
+    """``path`` contains one subdirectory per class name."""
+    docs: list[str] = []
+    labels: list[int] = []
+    for idx, cls in enumerate(CLASSES):
+        cls_dir = os.path.join(path, cls)
+        if not os.path.isdir(cls_dir):
+            continue
+        for f in sorted(glob.glob(os.path.join(cls_dir, "*"))):
+            if not os.path.isfile(f):
+                continue
+            with open(f, errors="replace") as fh:
+                docs.append(fh.read())
+            labels.append(idx)
+    if not docs:
+        raise FileNotFoundError(f"no newsgroup class directories under {path}")
+    return TextData(labels=np.asarray(labels, np.int32), data=docs)

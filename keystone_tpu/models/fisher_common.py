@@ -19,24 +19,19 @@ from keystone_tpu.ops.gmm import (
     GaussianMixtureModelEstimator,
 )
 from keystone_tpu.ops.linalg import BatchPCATransformer, compute_pca
-from keystone_tpu.ops.stats import NormalizeRows, SignedHellingerMapper
+from keystone_tpu.ops.stats import (
+    NormalizeRows,
+    SignedHellingerMapper,
+    sample_columns,
+)
 from keystone_tpu.ops.util import MatrixVectorizer
 
 logger = get_logger("keystone_tpu.models.fisher_common")
 
 
-def sample_descriptor_columns(desc, num: int, seed: int) -> jnp.ndarray:
-    """(N, d, m) → (≤num, d) rows sampled across all columns (the
-    reference's ColumnSampler feeding PCA/GMM fits)."""
-    n, d, m = desc.shape
-    flat = jnp.transpose(desc, (0, 2, 1)).reshape(n * m, d)
-    total = flat.shape[0]
-    if total > num:
-        idx = np.sort(
-            np.random.default_rng(seed).choice(total, num, replace=False)
-        )
-        flat = jnp.take(flat, jnp.asarray(idx), axis=0)
-    return flat
+# one jitted apply shared by every branch instance: the node travels as a
+# pytree argument, so new PCA/GMM fits reuse the compiled programs
+_apply_node = jax.jit(lambda node, d: node(d))
 
 
 class FisherBranch:
@@ -63,25 +58,29 @@ class FisherBranch:
         self.pca: BatchPCATransformer | None = None
         self.post = None
 
-    def fit(self, train_desc, chunk_size: int):
+    def fit(self, train_desc, chunk_size: int, n_valid: int | None = None):
         """Fit PCA/GMM (artifact-aware) and return the projected train
-        descriptors (reused by featurize of the training set)."""
+        descriptors (reused by featurize of the training set).
+
+        ``n_valid``: count of real rows when the batch was zero-padded for
+        sharding — pad images' all-zero descriptors are excluded from the
+        PCA/GMM sample (they would otherwise seed a spurious zero cluster).
+        """
+        fit_desc = train_desc if n_valid is None else train_desc[:n_valid]
         if self.pca_file and os.path.exists(self.pca_file):
             pca_mat = jnp.asarray(
                 np.loadtxt(self.pca_file, delimiter=",", ndmin=2), jnp.float32
             )
             logger.info("loaded PCA from %s", self.pca_file)
         else:
-            sample = sample_descriptor_columns(
-                train_desc, self.num_pca_samples, self.seed
-            )
+            sample = sample_columns(fit_desc, self.num_pca_samples, self.seed)
             pca_mat = compute_pca(sample, self.desc_dim)
             if self.pca_file:
                 np.savetxt(self.pca_file, np.asarray(pca_mat), delimiter=",")
         self.pca = BatchPCATransformer(pca_mat=pca_mat)
 
         projected = apply_in_chunks(
-            jax.jit(lambda d, p=self.pca: p(d)), train_desc, chunk_size
+            lambda d: _apply_node(self.pca, d), train_desc, chunk_size
         )
 
         if all(self.gmm_files) and all(
@@ -90,9 +89,8 @@ class FisherBranch:
             gmm = GaussianMixtureModel.load_csv(*self.gmm_files)
             logger.info("loaded GMM from %s", self.gmm_files[0])
         else:
-            sample = sample_descriptor_columns(
-                projected, self.num_gmm_samples, self.seed + 1
-            )
+            proj_fit = projected if n_valid is None else projected[:n_valid]
+            sample = sample_columns(proj_fit, self.num_gmm_samples, self.seed + 1)
             gmm = GaussianMixtureModelEstimator(k=self.vocab_size).fit(sample)
             if all(self.gmm_files):
                 gmm.save_csv(*self.gmm_files)
@@ -107,13 +105,12 @@ class FisherBranch:
         return projected
 
     def featurize_projected(self, projected, chunk_size: int):
-        fn = jax.jit(lambda p, d: p(d))
         return apply_in_chunks(
-            lambda d: fn(self.post, d), projected, chunk_size
+            lambda d: _apply_node(self.post, d), projected, chunk_size
         )
 
     def featurize(self, desc, chunk_size: int):
         projected = apply_in_chunks(
-            jax.jit(lambda d, p=self.pca: p(d)), desc, chunk_size
+            lambda d: _apply_node(self.pca, d), desc, chunk_size
         )
         return self.featurize_projected(projected, chunk_size)

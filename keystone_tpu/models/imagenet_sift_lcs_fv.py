@@ -126,8 +126,8 @@ def run(conf: ImageNetConfig, mesh=None) -> dict:
         x = shard_batch(images, mesh)
         sift_desc = apply_in_chunks(sift_fn, x, conf.chunk_size)
         lcs_desc = apply_in_chunks(lcs_fn, x, conf.chunk_size)
-        ps = sift_branch.fit(sift_desc, conf.chunk_size)
-        pl = lcs_branch.fit(lcs_desc, conf.chunk_size)
+        ps = sift_branch.fit(sift_desc, conf.chunk_size, n_valid=n_train)
+        pl = lcs_branch.fit(lcs_desc, conf.chunk_size, n_valid=n_train)
         return ZipVectors()(
             [
                 sift_branch.featurize_projected(ps, conf.chunk_size),

@@ -1,0 +1,130 @@
+"""20-Newsgroups text classification
+(reference ``pipelines/text/NewsgroupsPipeline.scala``):
+trim → lowercase → tokenize → n-grams (1..n) → binary term frequency →
+top-K sparse features dense-ified → multinomial naive Bayes → argmax."""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import jax
+import numpy as np
+
+from keystone_tpu.core.config import arg, parse_config
+from keystone_tpu.core.logging import get_logger
+from keystone_tpu.evaluation import MulticlassClassifierEvaluator
+from keystone_tpu.loaders.newsgroups import CLASSES, TextData, load_newsgroups
+from keystone_tpu.ops.naive_bayes import NaiveBayesEstimator
+from keystone_tpu.ops.nlp import LowerCase, NGramsFeaturizer, Tokenizer, Trim
+from keystone_tpu.ops.sparse import CommonSparseFeatures
+from keystone_tpu.ops.stats import TermFrequency
+from keystone_tpu.ops.util import MaxClassifier
+from keystone_tpu.parallel.mesh import create_mesh, shard_batch
+
+logger = get_logger("keystone_tpu.models.newsgroups")
+
+NUM_CLASSES = len(CLASSES)
+
+_SYNTH_VOCAB = [
+    ["galaxy", "rocket", "orbit", "launch", "telescope"],
+    ["goal", "hockey", "puck", "season", "playoff"],
+    ["windows", "driver", "graphics", "monitor", "software"],
+    ["engine", "motorcycle", "ride", "helmet", "brake"],
+]
+
+
+@dataclasses.dataclass
+class NewsgroupsConfig:
+    """Newsgroups workload (reference NewsgroupsConfig)."""
+
+    train_location: str = arg(default="", help="dir of class subdirectories")
+    test_location: str = arg(default="")
+    n_grams: int = arg(default=2, help="use 1..n grams")
+    common_features: int = arg(default=100_000, help="vocabulary cap")
+    synthetic: int = arg(default=0, help="if > 0, N synthetic documents")
+
+
+def _load(conf: NewsgroupsConfig, which: str) -> TextData:
+    if conf.synthetic:
+        n = conf.synthetic if which == "train" else max(conf.synthetic // 4, 1)
+        rng = np.random.default_rng(0 if which == "train" else 1)
+        docs, labels = [], []
+        for _ in range(n):
+            label = int(rng.integers(0, len(_SYNTH_VOCAB)))
+            words = list(rng.choice(_SYNTH_VOCAB[label], size=30)) + list(
+                rng.choice(["the", "a", "and", "of"], size=10)
+            )
+            rng.shuffle(words)
+            docs.append(" ".join(words))
+            labels.append(label)
+        return TextData(labels=np.asarray(labels, np.int32), data=docs)
+    return load_newsgroups(
+        conf.train_location if which == "train" else conf.test_location
+    )
+
+
+def run(conf: NewsgroupsConfig, mesh=None) -> dict:
+    if mesh is None and len(jax.devices()) > 1:
+        mesh = create_mesh()
+    t0 = time.perf_counter()
+    train, test = _load(conf, "train"), _load(conf, "test")
+
+    featurizer_host = (
+        Trim()
+        >> LowerCase()
+        >> Tokenizer()
+        >> NGramsFeaturizer(orders=tuple(range(1, conf.n_grams + 1)))
+        >> TermFrequency(fn=lambda x: 1)
+    )
+    train_tf = featurizer_host(train.data)
+    vectorizer = CommonSparseFeatures(conf.common_features).fit(train_tf)
+
+    x_train = shard_batch(vectorizer(train_tf), mesh)
+    n_train = len(train)
+    y_train = np.zeros(x_train.shape[0], np.int32)
+    y_train[:n_train] = train.labels
+
+    est = NaiveBayesEstimator(num_classes=NUM_CLASSES)
+    model = est.fit(x_train, y_train, n_valid=n_train)
+    predict = model >> MaxClassifier()
+    predict_jit = jax.jit(lambda p, b: p(b))
+
+    evaluator = MulticlassClassifierEvaluator(NUM_CLASSES)
+    train_eval = evaluator(
+        predict_jit(predict, x_train), y_train, n_valid=n_train
+    )
+
+    x_test = shard_batch(vectorizer(featurizer_host(test.data)), mesh)
+    n_test = len(test)
+    y_test = np.zeros(x_test.shape[0], np.int32)
+    y_test[:n_test] = test.labels
+    test_eval = evaluator(predict_jit(predict, x_test), y_test, n_valid=n_test)
+
+    result = {
+        "train_error": train_eval.error,
+        "test_error": test_eval.error,
+        "n_train": n_train,
+        "n_test": n_test,
+        "vocab_size": len(vectorizer.feature_space),
+        "total_s": time.perf_counter() - t0,
+    }
+    logger.info(
+        "Newsgroups: train err %.4f, test err %.4f (vocab %d)\n%s",
+        train_eval.error,
+        test_eval.error,
+        result["vocab_size"],
+        test_eval.summary(list(CLASSES)) if not conf.synthetic else "",
+    )
+    return result
+
+
+def main(argv=None) -> dict:
+    conf = parse_config(NewsgroupsConfig, argv)
+    if not conf.synthetic and not (conf.train_location and conf.test_location):
+        raise SystemExit("need --train-location AND --test-location, or --synthetic N")
+    return run(conf)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,149 @@
+"""TIMIT phoneme classification
+(reference ``pipelines/speech/TimitPipeline.scala``):
+440-dim pre-featurized frames → ``num_cosines`` batches of 4096 cosine
+random features (gaussian or cauchy W), each standard-scaled → block least
+squares over the feature batches with ``num_epochs`` BCD passes → argmax →
+multiclass eval (147 classes)."""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import jax
+import numpy as np
+
+from keystone_tpu.core.config import arg, parse_config
+from keystone_tpu.core.logging import get_logger
+from keystone_tpu.evaluation import MulticlassClassifierEvaluator
+from keystone_tpu.loaders.labeled import LabeledData
+from keystone_tpu.loaders.timit import NUM_CLASSES, TIMIT_DIMENSION, load_timit_split
+from keystone_tpu.ops.linear import BlockLeastSquaresEstimator
+from keystone_tpu.ops.stats import CosineRandomFeatures, StandardScaler
+from keystone_tpu.ops.util import ClassLabelIndicators, MaxClassifier
+from keystone_tpu.parallel.mesh import create_mesh, shard_batch
+
+logger = get_logger("keystone_tpu.models.timit")
+
+
+@dataclasses.dataclass
+class TimitConfig:
+    """TIMIT workload (reference TimitConfig: 50 batches x 4096 cosine
+    features, gamma 0.0555, 5 epochs)."""
+
+    train_data_location: str = arg(default="")
+    train_labels_location: str = arg(default="")
+    test_data_location: str = arg(default="")
+    test_labels_location: str = arg(default="")
+    num_cosines: int = arg(default=50, help="number of 4096-wide batches")
+    cosine_features: int = arg(default=4096)
+    gamma: float = arg(default=0.05555)
+    rf_type: str = arg(default="gaussian", choices=("gaussian", "cauchy"))
+    lam: float = arg(default=0.0)
+    num_epochs: int = arg(default=5)
+    seed: int = arg(default=123)
+    synthetic: int = arg(default=0, help="if > 0, N synthetic frames")
+
+
+def _load(conf: TimitConfig, which: str) -> LabeledData:
+    if conf.synthetic:
+        n = conf.synthetic if which == "train" else max(conf.synthetic // 5, 1)
+        rng = np.random.default_rng(0 if which == "train" else 1)
+        k = min(NUM_CLASSES, 12)
+        labels = rng.integers(0, k, size=n).astype(np.int32)
+        centers = np.random.default_rng(42).normal(
+            size=(k, TIMIT_DIMENSION)
+        )
+        data = (centers[labels] * 2 + rng.normal(size=(n, TIMIT_DIMENSION))).astype(
+            np.float32
+        )
+        return LabeledData(labels=labels, data=data)
+    if which == "train":
+        return load_timit_split(
+            conf.train_data_location, conf.train_labels_location
+        )
+    return load_timit_split(conf.test_data_location, conf.test_labels_location)
+
+
+def run(conf: TimitConfig, mesh=None) -> dict:
+    if mesh is None and len(jax.devices()) > 1:
+        mesh = create_mesh()
+    t0 = time.perf_counter()
+    train, test = _load(conf, "train"), _load(conf, "test")
+    n_train, n_test = len(train), len(test)
+
+    keys = jax.random.split(jax.random.key(conf.seed), conf.num_cosines)
+    featurizers = [
+        CosineRandomFeatures.create(
+            TIMIT_DIMENSION,
+            conf.cosine_features,
+            keys[i],
+            gamma=conf.gamma,
+            distribution=conf.rf_type,
+        )
+        for i in range(conf.num_cosines)
+    ]
+
+    x_train = shard_batch(train.data, mesh)
+    x_test = shard_batch(test.data, mesh)
+
+    apply_node = jax.jit(lambda node, b: node(b))
+    # per-batch cosine features, standard-scaled (fit on train)
+    train_blocks, scalers = [], []
+    for f in featurizers:
+        raw = apply_node(f, x_train)
+        scaler = StandardScaler().fit(raw, n_valid=n_train)
+        scalers.append(scaler)
+        train_blocks.append(apply_node(scaler, raw))
+
+    y = np.zeros(x_train.shape[0], np.int32)
+    y[:n_train] = train.labels
+    indicators = ClassLabelIndicators(num_classes=NUM_CLASSES)(y)
+    t_feat = time.perf_counter()
+
+    est = BlockLeastSquaresEstimator(
+        block_size=conf.cosine_features, num_iter=conf.num_epochs, lam=conf.lam
+    )
+    model = jax.block_until_ready(
+        est.fit(train_blocks, indicators, n_valid=n_train)
+    )
+    t_fit = time.perf_counter()
+
+    classify = MaxClassifier()
+    evaluator = MulticlassClassifierEvaluator(NUM_CLASSES)
+    train_eval = evaluator(classify(model(train_blocks)), y, n_valid=n_train)
+
+    test_blocks = [
+        apply_node(s, apply_node(f, x_test))
+        for f, s in zip(featurizers, scalers)
+    ]
+    y_test = np.zeros(x_test.shape[0], np.int32)
+    y_test[:n_test] = test.labels
+    test_eval = evaluator(
+        classify(model(test_blocks)), y_test, n_valid=n_test
+    )
+
+    result = {
+        "train_error": train_eval.error,
+        "test_error": test_eval.error,
+        "n_train": n_train,
+        "n_test": n_test,
+        "featurize_s": t_feat - t0,
+        "fit_s": t_fit - t_feat,
+        "total_s": time.perf_counter() - t0,
+    }
+    logger.info(
+        "Timit: train err %.4f, test err %.4f", train_eval.error, test_eval.error
+    )
+    return result
+
+
+def main(argv=None) -> dict:
+    conf = parse_config(TimitConfig, argv)
+    if not conf.synthetic and not conf.train_data_location:
+        raise SystemExit("need the four TIMIT locations, or --synthetic N")
+    return run(conf)
+
+
+if __name__ == "__main__":
+    main()

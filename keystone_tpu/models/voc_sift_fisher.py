@@ -114,7 +114,7 @@ def run(conf: VOCConfig, mesh=None) -> dict:
     )
     train_imgs = shard_batch(train.images, mesh)
     sift_train = apply_in_chunks(gray_sift, train_imgs, conf.chunk_size)
-    pca_train = branch.fit(sift_train, conf.chunk_size)
+    pca_train = branch.fit(sift_train, conf.chunk_size, n_valid=n_train)
     f_train = branch.featurize_projected(pca_train, conf.chunk_size)
     t_feat = time.perf_counter()
 

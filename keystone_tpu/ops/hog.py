@@ -98,7 +98,10 @@ def _hog(batch, cell: int):
     # block energies from contrast-insensitive sums
     insens = hist[..., :NUM_UNSIGNED] + hist[..., NUM_UNSIGNED:]
     energy = jnp.sum(insens * insens, axis=-1)  # (N, ch, cw)
-    pad_e = jnp.pad(energy, ((0, 0), (1, 1), (1, 1)))
+    # edge replication clamps out-of-range neighbor cells into the valid
+    # range, like the reference's border handling (zero padding would
+    # inflate boundary-cell normalization)
+    pad_e = jnp.pad(energy, ((0, 0), (1, 1), (1, 1)), mode="edge")
     # 2x2 block sums at the four diagonal positions around each cell
     e = pad_e
     blocks = [

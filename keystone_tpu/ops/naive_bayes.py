@@ -1,0 +1,64 @@
+"""Multinomial naive Bayes (reference nodes/learning/NaiveBayesModel.scala,
+which delegates training to Spark MLlib ``NaiveBayes.train``).
+
+Same model family and λ-smoothing as MLlib's multinomial NB, fitted with two
+one-hot matmuls over the (sharded) feature batch — per-class feature sums
+and class counts are psum-shaped contractions on TPU.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from keystone_tpu.core.pipeline import LabelEstimator, Transformer
+from keystone_tpu.core.treenode import static_field, treenode
+
+
+@treenode
+class NaiveBayesModel(Transformer):
+    """``log π + θ·x`` dense log-posteriors (reference NaiveBayesModel)."""
+
+    log_pi: jnp.ndarray  # (C,)
+    log_theta: jnp.ndarray  # (C, D)
+
+    def __call__(self, batch):
+        return batch @ self.log_theta.T + self.log_pi
+
+
+@treenode
+class NaiveBayesEstimator(LabelEstimator):
+    """Fit multinomial NB with λ smoothing (MLlib parity: λ=1.0 default).
+
+    ``data``: (N, D) non-negative counts; ``labels``: (N,) int classes.
+    """
+
+    num_classes: int = static_field(default=2)
+    lam: float = static_field(default=1.0)
+
+    def fit(self, data, labels, n_valid: int | None = None) -> NaiveBayesModel:
+        log_pi, log_theta = _nb_fit(
+            data, jnp.asarray(labels), n_valid, self.num_classes, self.lam
+        )
+        return NaiveBayesModel(log_pi=log_pi, log_theta=log_theta)
+
+
+@partial(jax.jit, static_argnames=("num_classes", "lam"))
+def _nb_fit(data, labels, n_valid, num_classes: int, lam: float):
+    n = data.shape[0]
+    mask = (
+        jnp.ones((n,), data.dtype)
+        if n_valid is None
+        else (jnp.arange(n) < n_valid).astype(data.dtype)
+    )
+    onehot = jax.nn.one_hot(labels, num_classes, dtype=data.dtype) * mask[:, None]
+    class_counts = jnp.sum(onehot, axis=0)  # (C,)
+    feature_sums = onehot.T @ data  # (C, D) — sharded contraction
+    total = jnp.sum(class_counts)
+    log_pi = jnp.log(class_counts + lam) - jnp.log(total + lam * num_classes)
+    log_theta = jnp.log(feature_sums + lam) - jnp.log(
+        jnp.sum(feature_sums, axis=1, keepdims=True) + lam * data.shape[1]
+    )
+    return log_pi, log_theta

@@ -198,26 +198,43 @@ class Sampler:
         return jnp.take(batch, jnp.asarray(np.sort(idx)), axis=0)
 
 
+def sample_columns(desc, num: int, seed: int) -> jnp.ndarray:
+    """Sample up to ``num`` descriptor columns as (num, d) rows.
+
+    ``desc``: an (N, d, m) batch of feature-major descriptor matrices, or a
+    list of (d, n_i) matrices (ragged). The single implementation behind
+    :class:`ColumnSampler` and the Fisher pipelines' PCA/GMM sampling.
+    """
+    if isinstance(desc, (list, tuple)):
+        flat = jnp.concatenate(
+            [jnp.asarray(m).T for m in desc], axis=0
+        )  # (Σn_i, d)
+    else:
+        n, d, m = desc.shape
+        flat = jnp.transpose(desc, (0, 2, 1)).reshape(n * m, d)
+    total = flat.shape[0]
+    if total > num:
+        idx = np.sort(
+            np.random.default_rng(seed).choice(total, num, replace=False)
+        )
+        flat = jnp.take(flat, jnp.asarray(idx), axis=0)
+    return flat
+
+
 @treenode
 class ColumnSampler:
-    """Sample ``num_cols`` columns across a batch of (d, n_i) matrices
+    """Sample ``num_cols`` columns across descriptor matrices
     (nodes/stats/Sampling.scala ColumnSampler).
 
-    Input: list/array of per-item descriptor matrices (feature-major, like
-    the reference's SIFT output). Output: (num_cols, d) row batch suitable
-    for PCA/GMM fits.
+    Input: (N, d, m) array or list of per-item (d, n_i) feature-major
+    matrices. Output: (num_cols, d) row batch suitable for PCA/GMM fits.
     """
 
     num_cols: int = static_field(default=100000)
     seed: int = static_field(default=42)
 
     def __call__(self, mats):
-        rng = np.random.default_rng(self.seed)
-        cols = np.concatenate([np.asarray(m).T for m in mats], axis=0)
-        if cols.shape[0] > self.num_cols:
-            idx = rng.choice(cols.shape[0], self.num_cols, replace=False)
-            cols = cols[np.sort(idx)]
-        return jnp.asarray(cols)
+        return sample_columns(mats, self.num_cols, self.seed)
 
 
 @treenode

@@ -1,0 +1,172 @@
+"""NLP stack tests (reference NGramSuite, NGramIndexerSuite,
+StupidBackoffSuite, SparseFeatureVectorizerSuite, NaiveBayes parity)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu.ops.naive_bayes import NaiveBayesEstimator
+from keystone_tpu.ops.nlp import (
+    LowerCase,
+    NaiveBitPackIndexer,
+    NGramIndexer,
+    NGramsCounts,
+    NGramsFeaturizer,
+    StupidBackoffEstimator,
+    Tokenizer,
+    Trim,
+    WordFrequencyEncoder,
+    initial_bigram_shard,
+)
+from keystone_tpu.ops.sparse import (
+    AllSparseFeatures,
+    CommonSparseFeatures,
+)
+from keystone_tpu.ops.stats import TermFrequency
+
+
+def test_string_nodes():
+    out = (Trim() >> LowerCase() >> Tokenizer())(["  Hello, World!  "])
+    assert out == [["hello", "world"]]
+
+
+def test_ngrams_featurizer_orders():
+    grams = NGramsFeaturizer(orders=(1, 2))([["a", "b", "c"]])[0]
+    assert ("a",) in grams and ("a", "b") in grams and ("b", "c") in grams
+    assert ("a", "b", "c") not in grams
+    assert grams.count(("b",)) == 1
+    with pytest.raises(ValueError):
+        NGramsFeaturizer(orders=(1, 3))
+
+
+def test_ngrams_counts_sorted_desc():
+    counts = NGramsCounts()([[("a",), ("b",), ("a",)], [("a",)]])
+    assert counts[0] == (("a",), 3)
+    assert dict(counts)[("b",)] == 1
+
+
+def test_bitpack_indexer_roundtrip():
+    ix = NaiveBitPackIndexer
+    tri = ix.pack([5, 17, 999])
+    assert ix.ngram_order(tri) == 3
+    assert [ix.unpack(tri, p) for p in (0, 1, 2)] == [5, 17, 999]
+    bi = ix.remove_current_word(tri)
+    assert ix.ngram_order(bi) == 2
+    assert [ix.unpack(bi, p) for p in (0, 1)] == [5, 17]
+    assert ix.ngram_order(ix.remove_farthest_word(tri)) == 2
+    assert ix.unpack(ix.remove_farthest_word(bi), 0) == 17
+    with pytest.raises(ValueError):
+        ix.pack([1 << 20])
+
+
+def test_word_frequency_encoder_order_and_oov():
+    model = WordFrequencyEncoder().fit([["b", "a", "b", "c", "b", "a"]])
+    assert model.word_index["b"] == 0  # most frequent
+    assert model.word_index["a"] == 1
+    out = model([["b", "zzz", "c"]])
+    assert out == [[0, -1, 2]]
+    assert model.unigram_counts[0] == 3
+
+
+def test_stupid_backoff_scores():
+    """Hand-computed Stupid Backoff values on a tiny corpus."""
+    # corpus tokens: a b a b c (ids)
+    unigrams = {0: 2, 1: 2, 2: 1}  # a:2 b:2 c:1, N = 5
+    counts = {(0, 1): 2, (1, 0): 1, (1, 2): 1, (0, 1, 0): 1, (0, 1, 2): 1}
+    model = StupidBackoffEstimator(unigrams, alpha=0.4).fit(counts)
+    # seen bigram: freq(a,b)/freq(a) = 2/2
+    assert abs(model.score((0, 1)) - 1.0) < 1e-9
+    # seen trigram: freq(a,b,c)/freq(a,b) = 1/2
+    assert abs(model.score((0, 1, 2)) - 0.5) < 1e-9
+    # unseen bigram (c,a): backoff 0.4 * S(a) = 0.4 * 2/5
+    assert abs(model.score((2, 0)) - 0.4 * 2 / 5) < 1e-9
+    # unigram: freq/N
+    assert abs(model.score((2,)) - 1 / 5) < 1e-9
+    # unseen trigram with seen suffix: 0.4 * S(b,c) = 0.4 * freq(b,c)/freq(b)
+    assert abs(model.score((2, 1, 2)) - 0.4 * (1 / 2)) < 1e-9
+
+
+def test_stupid_backoff_context_colocation():
+    """Every ngram lands in the same shard as its backoff context when they
+    share the first two words (reference StupidBackoffSuite invariant)."""
+    rng = np.random.default_rng(0)
+    docs = [[int(x) for x in rng.integers(0, 6, size=20)] for _ in range(10)]
+    grams = NGramsFeaturizer(orders=(1, 2, 3))(docs)
+    all_counts = dict(NGramsCounts()(grams))
+    unigrams = {k[0]: v for k, v in all_counts.items() if len(k) == 1}
+    counts = {k: v for k, v in all_counts.items() if len(k) > 1}
+    model = StupidBackoffEstimator(unigrams).fit(counts)
+    shards = model.scores_by_shard(4)
+    for ngram in counts:
+        if len(ngram) == 3:
+            s3 = initial_bigram_shard(ngram, 4)
+            s2 = initial_bigram_shard(ngram[:2], 4)
+            assert s3 == s2  # same first-two-words → same shard
+            assert ngram in shards[s3]
+
+
+def test_term_frequency_and_sparse_features():
+    docs = [["a", "b", "a"], ["b", "c"], ["b"]]
+    tf = TermFrequency(fn=lambda x: 1)(docs)
+    vec = CommonSparseFeatures(2).fit(tf)
+    out = np.asarray(vec(tf))
+    assert out.shape == (3, 2)
+    # 'b' appears in 3 docs -> index 0; 'a' in 1, 'c' in 1 (tie by repr)
+    assert vec.feature_space["b"] == 0
+    np.testing.assert_array_equal(out[:, 0], [1, 1, 1])
+    all_vec = AllSparseFeatures().fit(tf)
+    assert len(all_vec.feature_space) == 3
+
+
+def test_naive_bayes_matches_sklearn_style_formula(rng):
+    n, d, c = 60, 8, 3
+    x = rng.integers(0, 5, size=(n, d)).astype(np.float32)
+    labels = rng.integers(0, c, size=n).astype(np.int32)
+    model = NaiveBayesEstimator(num_classes=c, lam=1.0).fit(
+        jnp.asarray(x), labels
+    )
+    # direct formula
+    log_pi = np.zeros(c)
+    log_theta = np.zeros((c, d))
+    for k in range(c):
+        nk = (labels == k).sum()
+        log_pi[k] = np.log((nk + 1) / (n + c))
+        fs = x[labels == k].sum(0)
+        log_theta[k] = np.log((fs + 1) / (fs.sum() + d))
+    np.testing.assert_allclose(np.asarray(model.log_pi), log_pi, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(model.log_theta), log_theta, rtol=1e-4)
+    # prediction = argmax posterior
+    post = np.asarray(model(jnp.asarray(x)))
+    np.testing.assert_allclose(
+        post, x @ log_theta.T + log_pi, rtol=1e-4
+    )
+
+
+def test_newsgroups_synthetic_end_to_end(mesh8):
+    from keystone_tpu.models import newsgroups_pipeline as ng
+
+    res = ng.run(ng.NewsgroupsConfig(synthetic=120, n_grams=2), mesh=mesh8)
+    assert res["train_error"] < 0.05
+    assert res["test_error"] < 0.2
+
+
+def test_timit_synthetic_end_to_end():
+    from keystone_tpu.models import timit_pipeline as tp
+
+    conf = tp.TimitConfig(
+        synthetic=300, num_cosines=2, cosine_features=512, lam=5.0, num_epochs=2
+    )
+    res = tp.run(conf, mesh=None)
+    assert res["train_error"] < 0.05
+    assert res["test_error"] < 0.35
+
+
+def test_stupid_backoff_pipeline_synthetic():
+    from keystone_tpu.models import stupid_backoff_pipeline as sb
+
+    result, model, encoder = sb.run(sb.StupidBackoffConfig(synthetic=200))
+    assert result["num_ngrams"] > 0
+    # every seen ngram scores in (0, 1]
+    for ngram in list(model.ngram_counts)[:200]:
+        s = model.score(ngram)
+        assert 0 < s <= 1.0

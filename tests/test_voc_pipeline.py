@@ -79,3 +79,32 @@ def test_imagenet_synthetic_end_to_end():
     assert res["train_top1_error"] < 0.5  # strong synthetic signal
     assert res["train_top5_error"] <= res["train_top1_error"]
     assert 0.0 <= res["test_top5_error"] <= 1.0
+
+
+def test_tar_loader_skips_corrupt_images(tmp_path):
+    import io
+    import tarfile
+
+    from PIL import Image
+
+    from keystone_tpu.loaders.image_loaders import load_tar_images
+
+    tar = str(tmp_path / "mix.tar")
+    with tarfile.open(tar, "w") as tf:
+        ti = tarfile.TarInfo("bad.jpg")
+        data = b"\xff\xd8garbage"
+        ti.size = len(data)
+        tf.addfile(ti, io.BytesIO(data))
+        buf = io.BytesIO()
+        Image.fromarray(np.zeros((16, 16, 3), np.uint8)).save(buf, "JPEG")
+        ti = tarfile.TarInfo("good.jpg")
+        data = buf.getvalue()
+        ti.size = len(data)
+        tf.addfile(ti, io.BytesIO(data))
+        ti = tarfile.TarInfo("notes.txt")
+        data = b"skip me"
+        ti.size = len(data)
+        tf.addfile(ti, io.BytesIO(data))
+    names, imgs = load_tar_images([tar], 32)
+    assert names == ["good.jpg"]
+    assert imgs.shape == (1, 32, 32, 3)
