@@ -1,0 +1,77 @@
+"""Pipeline launcher: ``python -m keystone_tpu <pipeline> [args...]``.
+
+The successor of the reference's ``bin/run-pipeline.sh <Class> args``
+(SURVEY.md layer 8): dispatches to a model's ``main`` by short name or by
+reference-style class name, so existing KeystoneML invocations map 1:1.
+"""
+
+from __future__ import annotations
+
+import sys
+
+# short name → (module, reference class name)
+PIPELINES = {
+    "mnist-random-fft": (
+        "keystone_tpu.models.mnist_random_fft",
+        "pipelines.images.mnist.MnistRandomFFT",
+    ),
+    "cifar-linear-pixels": (
+        "keystone_tpu.models.cifar_linear_pixels",
+        "pipelines.images.cifar.LinearPixels",
+    ),
+    "cifar-random-patch": (
+        "keystone_tpu.models.cifar_random_patch",
+        "pipelines.images.cifar.RandomPatchCifar",
+    ),
+    "voc-sift-fisher": (
+        "keystone_tpu.models.voc_sift_fisher",
+        "pipelines.images.voc.VOCSIFTFisher",
+    ),
+    "imagenet-sift-lcs-fv": (
+        "keystone_tpu.models.imagenet_sift_lcs_fv",
+        "pipelines.images.imagenet.ImageNetSiftLcsFV",
+    ),
+    "timit": (
+        "keystone_tpu.models.timit_pipeline",
+        "pipelines.speech.TimitPipeline",
+    ),
+    "newsgroups": (
+        "keystone_tpu.models.newsgroups_pipeline",
+        "pipelines.text.NewsgroupsPipeline",
+    ),
+    "stupid-backoff": (
+        "keystone_tpu.models.stupid_backoff_pipeline",
+        "pipelines.nlp.StupidBackoffPipeline",
+    ),
+    "vit-ridge": ("keystone_tpu.models.vit_ridge", None),
+}
+
+
+def main(argv: list[str] | None = None) -> None:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        names = "\n  ".join(sorted(PIPELINES))
+        raise SystemExit(
+            f"usage: python -m keystone_tpu <pipeline> [args...]\n"
+            f"pipelines:\n  {names}\n"
+            f"(reference class names like pipelines.images.mnist.MnistRandomFFT"
+            f" are also accepted)"
+        )
+    name, rest = argv[0], argv[1:]
+    target = None
+    if name in PIPELINES:
+        target = PIPELINES[name][0]
+    else:
+        for _short, (mod, ref) in PIPELINES.items():
+            if ref == name:
+                target = mod
+                break
+    if target is None:
+        raise SystemExit(f"unknown pipeline {name!r}; run with --help for a list")
+    import importlib
+
+    importlib.import_module(target).main(rest)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""Tracing / profiling hooks.
+
+The reference's observability is Spark's UI plus wall-clock brackets and
+``RDD.setName`` tags (SURVEY.md §5). Here the same two ideas map to:
+
+- :func:`trace` — capture an XLA/TPU profile (tensorboard-viewable) around
+  a code block (``jax.profiler``),
+- :func:`annotate` — name a region so it shows up in the trace timeline
+  (the ``setName`` analog),
+- :func:`log_time` (re-exported from core.logging) — wall-clock brackets.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from keystone_tpu.core.logging import get_logger, log_time  # noqa: F401
+
+logger = get_logger("keystone_tpu.profiling")
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Profile the enclosed block to ``log_dir`` (view with tensorboard)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        logger.info("profile written to %s", log_dir)
+
+
+def annotate(name: str):
+    """Named region in profiler timelines (the RDD.setName analog)."""
+    return jax.profiler.TraceAnnotation(name)

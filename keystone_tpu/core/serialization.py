@@ -1,0 +1,38 @@
+"""Fitted-pipeline persistence.
+
+The reference's only model persistence is CSV loads of precomputed PCA/GMM
+artifacts (SURVEY.md §5 checkpoint/resume); those formats are kept (see
+``ops.gmm``/model pca_file flags). Because every fitted node here is a
+pytree of arrays + static config, whole pipelines additionally checkpoint
+generically: leaves are pulled to host numpy and pickled with the dataclass
+structure, so ``load_pipeline`` returns a ready-to-jit pipeline.
+"""
+
+from __future__ import annotations
+
+import pickle
+
+import jax
+import numpy as np
+
+_MAGIC = b"KSTP1\n"
+
+
+def save_pipeline(node, path: str) -> None:
+    """Persist a fitted Transformer/Pipeline (any pytree node) to ``path``."""
+    host = jax.tree_util.tree_map(
+        lambda leaf: np.asarray(leaf) if hasattr(leaf, "shape") else leaf, node
+    )
+    with open(path, "wb") as f:
+        f.write(_MAGIC)
+        pickle.dump(host, f, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def load_pipeline(path: str):
+    """Load a pipeline saved by :func:`save_pipeline`; arrays return as
+    device arrays on first use (jnp.asarray on apply)."""
+    with open(path, "rb") as f:
+        magic = f.read(len(_MAGIC))
+        if magic != _MAGIC:
+            raise ValueError(f"{path} is not a keystone_tpu pipeline checkpoint")
+        return pickle.load(f)

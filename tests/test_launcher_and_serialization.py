@@ -1,0 +1,75 @@
+"""Launcher dispatch + fitted-pipeline checkpoint tests."""
+
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu.core.serialization import load_pipeline, save_pipeline
+from keystone_tpu.ops.linear import LinearMapEstimator
+from keystone_tpu.ops.stats import StandardScaler
+
+
+def test_save_load_fitted_pipeline_roundtrip(tmp_path, rng):
+    a = jnp.asarray(rng.normal(size=(40, 6)).astype(np.float32))
+    b = jnp.asarray(rng.normal(size=(40, 3)).astype(np.float32))
+    pipe = StandardScaler().fit(a) >> LinearMapEstimator(lam=0.1).fit(a, b)
+    path = str(tmp_path / "model.kstp")
+    save_pipeline(pipe, path)
+    loaded = load_pipeline(path)
+    np.testing.assert_allclose(
+        np.asarray(loaded(a)), np.asarray(pipe(a)), atol=1e-6
+    )
+    # loaded pipeline is jittable
+    out = jax.jit(lambda p, x: p(x))(loaded, a)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(pipe(a)), atol=1e-6)
+
+
+def test_load_rejects_garbage(tmp_path):
+    path = str(tmp_path / "junk.bin")
+    with open(path, "wb") as f:
+        f.write(b"not a checkpoint")
+    with pytest.raises(ValueError):
+        load_pipeline(path)
+
+
+def test_main_dispatch_by_short_and_reference_name():
+    from keystone_tpu.__main__ import PIPELINES, main
+
+    with pytest.raises(SystemExit) as e:
+        main(["--help"])
+    assert "mnist-random-fft" in str(e.value)
+    with pytest.raises(SystemExit):
+        main(["no-such-pipeline"])
+    assert PIPELINES["mnist-random-fft"][1] == "pipelines.images.mnist.MnistRandomFFT"
+
+
+def test_launcher_script_runs():
+    out = subprocess.run(
+        ["bash", "bin/run-pipeline.sh", "--help"],
+        capture_output=True,
+        text=True,
+        cwd="/root/repo",
+    )
+    assert "pipelines:" in out.stderr or "pipelines:" in out.stdout
+
+
+def test_main_runs_reference_class_name():
+    from keystone_tpu.__main__ import main
+
+    main(
+        [
+            "pipelines.images.mnist.MnistRandomFFT",
+            "--synthetic",
+            "64",
+            "--num-ffts",
+            "1",
+            "--block-size",
+            "512",
+            "--lam",
+            "5",
+        ]
+    )
