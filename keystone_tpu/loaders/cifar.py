@@ -24,17 +24,26 @@ def load_cifar(path: str, dtype=np.float32) -> LabeledImages:
         files = sorted(glob.glob(os.path.join(path, "*.bin")))
     else:
         files = sorted(glob.glob(path)) or [path]
-    raws = []
+    from keystone_tpu.native import native_load_cifar
+
+    all_labels, all_images = [], []
     for f in files:
-        raw = np.fromfile(f, dtype=np.uint8)
-        if raw.size % RECORD:
-            raise ValueError(
-                f"{f}: size {raw.size} is not a multiple of the "
-                f"{RECORD}-byte CIFAR-10 record"
-            )
-        raws.append(raw.reshape(-1, RECORD))
-    recs = np.concatenate(raws, axis=0)
-    labels = recs[:, 0].astype(np.int32)
-    planes = recs[:, 1:].reshape(-1, NCHAN, NROW, NCOL)  # (N, C, H, W)
-    images = np.transpose(planes, (0, 2, 3, 1)).astype(dtype)  # NHWC
-    return LabeledImages(labels=labels, images=images)
+        native = native_load_cifar(f)
+        if native is not None:
+            labels, images = native
+        else:
+            raw = np.fromfile(f, dtype=np.uint8)
+            if raw.size % RECORD:
+                raise ValueError(
+                    f"{f}: size {raw.size} is not a multiple of the "
+                    f"{RECORD}-byte CIFAR-10 record"
+                )
+            recs = raw.reshape(-1, RECORD)
+            labels = recs[:, 0].astype(np.int32)
+            planes = recs[:, 1:].reshape(-1, NCHAN, NROW, NCOL)  # (N, C, H, W)
+            images = np.transpose(planes, (0, 2, 3, 1)).astype(np.float32)
+        all_labels.append(labels)
+        all_images.append(images.astype(dtype, copy=False))
+    return LabeledImages(
+        labels=np.concatenate(all_labels), images=np.concatenate(all_images)
+    )

