@@ -1,0 +1,113 @@
+"""ViT-ridge: frozen random ViT featurizer + ridge solver (the BASELINE
+stretch config — the reference's random-features philosophy on a modern
+encoder; CIFAR-shaped by default, ImageNet-shaped by flags)."""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import jax
+import numpy as np
+
+from keystone_tpu.core.batching import apply_in_chunks
+from keystone_tpu.core.config import arg, parse_config
+from keystone_tpu.core.logging import get_logger
+from keystone_tpu.evaluation import MulticlassClassifierEvaluator
+from keystone_tpu.models.cifar_linear_pixels import LinearPixelsConfig, _load
+from keystone_tpu.ops.linear import LinearMapEstimator
+from keystone_tpu.ops.util import ClassLabelIndicators, MaxClassifier
+from keystone_tpu.ops.vit import ViTFeaturizer
+from keystone_tpu.parallel.mesh import create_mesh, shard_batch
+
+logger = get_logger("keystone_tpu.models.vit_ridge")
+
+NUM_CLASSES = 10
+
+
+@dataclasses.dataclass
+class ViTRidgeConfig:
+    train_location: str = arg(default="", help="CIFAR-10 binary file/dir")
+    test_location: str = arg(default="")
+    patch_size: int = arg(default=8)
+    dim: int = arg(default=128)
+    depth: int = arg(default=4)
+    num_heads: int = arg(default=4)
+    lam: float = arg(default=1.0)
+    chunk_size: int = arg(default=512)
+    seed: int = arg(default=0)
+    synthetic: int = arg(default=0, help="if > 0, N synthetic samples")
+
+
+def run(conf: ViTRidgeConfig, mesh=None) -> dict:
+    if mesh is None and len(jax.devices()) > 1:
+        mesh = create_mesh()
+    t0 = time.perf_counter()
+    lp_conf = LinearPixelsConfig(
+        train_location=conf.train_location,
+        test_location=conf.test_location,
+        synthetic=conf.synthetic,
+    )
+    train, test = _load(lp_conf, "train"), _load(lp_conf, "test")
+    n_train, n_test = len(train), len(test)
+
+    vit = ViTFeaturizer.create(
+        jax.random.key(conf.seed),
+        image_size=train.images.shape[1],
+        patch_size=conf.patch_size,
+        dim=conf.dim,
+        depth=conf.depth,
+        num_heads=conf.num_heads,
+    )
+    feat_fn = jax.jit(lambda b, v=vit: v(b / 255.0))
+
+    def featurize(images):
+        return apply_in_chunks(feat_fn, shard_batch(images, mesh), conf.chunk_size)
+
+    f_train = featurize(train.images)
+    t_feat = time.perf_counter()
+
+    y = np.zeros(f_train.shape[0], np.int32)
+    y[:n_train] = train.labels
+    indicators = ClassLabelIndicators(num_classes=NUM_CLASSES)(y)
+    model = LinearMapEstimator(lam=conf.lam).fit(
+        f_train, indicators, n_valid=n_train
+    )
+    t_fit = time.perf_counter()
+
+    classify = MaxClassifier()
+    evaluator = MulticlassClassifierEvaluator(NUM_CLASSES)
+    train_eval = evaluator(classify(model(f_train)), y, n_valid=n_train)
+    f_test = featurize(test.images)
+    y_test = np.zeros(f_test.shape[0], np.int32)
+    y_test[:n_test] = test.labels
+    test_eval = evaluator(classify(model(f_test)), y_test, n_valid=n_test)
+
+    result = {
+        "train_error": train_eval.error,
+        "test_error": test_eval.error,
+        "n_train": n_train,
+        "n_test": n_test,
+        "featurize_s": t_feat - t0,
+        "fit_s": t_fit - t_feat,
+        "total_s": time.perf_counter() - t0,
+        "featurize_fit_samples_per_s": n_train / (t_fit - t0),
+    }
+    logger.info(
+        "ViTRidge: train err %.4f, test err %.4f, %.0f samples/s",
+        train_eval.error,
+        test_eval.error,
+        result["featurize_fit_samples_per_s"],
+    )
+    return result
+
+
+def main(argv=None) -> dict:
+    conf = parse_config(ViTRidgeConfig, argv)
+    if not conf.synthetic and not (conf.train_location and conf.test_location):
+        raise SystemExit("need --train-location AND --test-location, or --synthetic N")
+    return run(conf)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,156 @@
+"""Attention with sequence/context parallelism over the mesh.
+
+The reference has no sequence models (SURVEY.md §5), but long-context is
+first-class here: two standard distributed-attention strategies scale the
+sequence axis across chips, with collectives riding ICI:
+
+- :func:`ring_attention` — blockwise attention with K/V blocks rotating
+  around the mesh axis via ``ppermute`` while each chip keeps its query
+  shard; a numerically-stable online softmax (flash-style running max/sum)
+  accumulates across ring steps. Memory per chip is O(S/n · S/n) per step
+  instead of O(S²).
+- :func:`ulysses_attention` — all-to-all resharding: swap sequence-sharding
+  for head-sharding (``lax.all_to_all``), run dense local attention over
+  full sequences on 1/n of the heads, swap back.
+
+Both are exact (== dense attention) and composable under jit; tests verify
+equality on an 8-device mesh. ``dense_attention`` is the single-chip
+reference implementation.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def dense_attention(q, k, v, *, causal: bool = False):
+    """Reference multi-head attention. q,k,v: (B, H, S, D)."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        sq, sk = q.shape[2], k.shape[2]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def _ring_attention_shard(q, k, v, *, axis_name: str, causal: bool):
+    """Per-shard ring attention body (runs under shard_map).
+
+    q, k, v: (B, H, S_local, D) — this chip's sequence shard.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, h, s_local, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+
+    q_pos = idx * s_local + jnp.arange(s_local)  # global query positions
+
+    m = jnp.full((b, h, s_local, 1), -jnp.inf, q.dtype)
+    l = jnp.zeros((b, h, s_local, 1), q.dtype)
+    acc = jnp.zeros_like(q)
+
+    k_blk, v_blk = k, v
+    for step in range(n):
+        owner = (idx - step) % n  # which chip's K/V block we hold now
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk) * scale
+        if causal:
+            k_pos = owner * s_local + jnp.arange(s_local)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            scores = jnp.where(mask, scores, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
+        # guard fully-masked rows (m_new = -inf): exp(-inf - -inf) → nan
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(scores - m_safe)
+        p = jnp.where(jnp.isfinite(scores), p, 0.0)
+        alpha = jnp.where(
+            jnp.isfinite(m), jnp.exp(m - m_safe), jnp.zeros_like(m)
+        )
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.einsum("bhqk,bhkd->bhqd", p, v_blk)
+        m = m_new
+        if step + 1 < n:
+            perm = [(j, (j + 1) % n) for j in range(n)]
+            k_blk = lax.ppermute(k_blk, axis_name, perm)
+            v_blk = lax.ppermute(v_blk, axis_name, perm)
+    return acc / jnp.maximum(l, 1e-30)
+
+
+def ring_attention(
+    q,
+    k,
+    v,
+    mesh: Mesh,
+    *,
+    seq_axis: str = "data",
+    causal: bool = False,
+):
+    """Exact attention with the sequence axis sharded over ``seq_axis``.
+
+    q, k, v: (B, H, S, D) global arrays (S divisible by the axis size).
+    """
+    spec = P(None, None, seq_axis, None)
+    fn = jax.shard_map(
+        partial(_ring_attention_shard, axis_name=seq_axis, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)
+
+
+def _ulysses_shard(q, k, v, *, axis_name: str, causal: bool):
+    """All-to-all sequence↔head resharding (DeepSpeed-Ulysses style).
+
+    In: (B, H, S_local, D) sequence-sharded → all_to_all → (B, H/n, S, D)
+    head-sharded → dense attention → all_to_all back.
+    """
+
+    def seq_to_heads(x):
+        # split heads across the axis, gather sequence
+        return lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    def heads_to_seq(x):
+        return lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = dense_attention(qh, kh, vh, causal=causal)
+    return heads_to_seq(out)
+
+
+def ulysses_attention(
+    q,
+    k,
+    v,
+    mesh: Mesh,
+    *,
+    seq_axis: str = "data",
+    causal: bool = False,
+):
+    """Exact attention via all-to-all head/sequence resharding.
+
+    Requires H divisible by the axis size. Prefers ICI bandwidth over ring
+    latency — the usual pick when heads are plentiful.
+    """
+    n = mesh.shape[seq_axis]
+    if q.shape[1] % n:
+        raise ValueError(f"heads ({q.shape[1]}) not divisible by axis ({n})")
+    spec = P(None, None, seq_axis, None)
+    fn = jax.shard_map(
+        partial(_ulysses_shard, axis_name=seq_axis, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

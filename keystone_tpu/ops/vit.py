@@ -1,0 +1,122 @@
+"""ViT-style patch-embedding featurizer.
+
+The BASELINE stretch config: a transformer-encoder featurizer in the
+pipeline DSL ("stretch the Transformer API") feeding the ridge solver — the
+random-features philosophy of the reference (random FFTs, random conv
+patches) applied to a modern architecture: a frozen randomly-initialized
+ViT encoder as the featurizer, linear model on top.
+
+Everything is a pytree; attention can run dense (single chip) or
+sequence-parallel via :mod:`keystone_tpu.ops.attention` on a mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+
+from keystone_tpu.core.pipeline import Transformer
+from keystone_tpu.core.treenode import static_field, treenode
+from keystone_tpu.ops.attention import dense_attention, ring_attention
+from keystone_tpu.ops.images import extract_patches
+
+
+def _layer_norm(x, eps=1e-6):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps)
+
+
+@treenode
+class ViTBlock:
+    wq: jnp.ndarray
+    wk: jnp.ndarray
+    wv: jnp.ndarray
+    wo: jnp.ndarray
+    w1: jnp.ndarray
+    w2: jnp.ndarray
+    num_heads: int = static_field(default=4)
+
+
+@treenode
+class ViTFeaturizer(Transformer):
+    """(N, H, W, C) images → (N, dim) pooled encoder features."""
+
+    patch_embed: jnp.ndarray  # (P²·C, dim)
+    pos_embed: jnp.ndarray  # (num_patches, dim)
+    blocks: tuple  # of ViTBlock
+    patch_size: int = static_field(default=8)
+    mesh: object = static_field(default=None)  # sequence-parallel when set
+    seq_axis: str = static_field(default="data")
+
+    def __call__(self, batch):
+        n = batch.shape[0]
+        p = extract_patches(batch, self.patch_size, self.patch_size)
+        x = p.reshape(n, -1, p.shape[-1]) @ self.patch_embed  # (N, S, dim)
+        x = x + self.pos_embed
+        for blk in self.blocks:
+            x = x + self._attention(_layer_norm(x), blk)
+            h = _layer_norm(x) @ blk.w1
+            x = x + jax.nn.gelu(h) @ blk.w2
+        return jnp.mean(_layer_norm(x), axis=1)  # (N, dim)
+
+    def _attention(self, x, blk: ViTBlock):
+        n, s, d = x.shape
+        heads = blk.num_heads
+        hd = d // heads
+
+        def split(w):
+            return (x @ w).reshape(n, s, heads, hd).transpose(0, 2, 1, 3)
+
+        q, k, v = split(blk.wq), split(blk.wk), split(blk.wv)
+        if self.mesh is not None:
+            out = ring_attention(q, k, v, self.mesh, seq_axis=self.seq_axis)
+        else:
+            out = dense_attention(q, k, v)
+        out = out.transpose(0, 2, 1, 3).reshape(n, s, d)
+        return out @ blk.wo
+
+    @staticmethod
+    def create(
+        key,
+        image_size: int = 32,
+        patch_size: int = 8,
+        dim: int = 128,
+        depth: int = 4,
+        num_heads: int = 4,
+        channels: int = 3,
+        mesh=None,
+        seq_axis: str = "data",
+    ) -> "ViTFeaturizer":
+        num_patches = (image_size // patch_size) ** 2
+        keys = jax.random.split(key, 2 + 6 * depth)
+        pd = patch_size * patch_size * channels
+
+        def init(k, shape, fan_in):
+            return jax.random.normal(k, shape, jnp.float32) / math.sqrt(fan_in)
+
+        blocks = []
+        for i in range(depth):
+            ks = keys[2 + 6 * i : 8 + 6 * i]
+            blocks.append(
+                ViTBlock(
+                    wq=init(ks[0], (dim, dim), dim),
+                    wk=init(ks[1], (dim, dim), dim),
+                    wv=init(ks[2], (dim, dim), dim),
+                    wo=init(ks[3], (dim, dim), dim),
+                    w1=init(ks[4], (dim, 4 * dim), dim),
+                    w2=init(ks[5], (4 * dim, dim), 4 * dim),
+                    num_heads=num_heads,
+                )
+            )
+        return ViTFeaturizer(
+            patch_embed=init(keys[0], (pd, dim), pd),
+            pos_embed=0.02 * jax.random.normal(keys[1], (num_patches, dim)),
+            blocks=tuple(blocks),
+            patch_size=patch_size,
+            mesh=mesh,
+            seq_axis=seq_axis,
+        )
