@@ -1,0 +1,62 @@
+"""Linguistic feature extraction
+(reference nodes/nlp/CoreNLPFeatureExtractor.scala, which wraps the external
+sista/CoreNLP ``FastNLPProcessor`` for tokenize → lemmatize → NER-replace →
+n-grams).
+
+That external JVM dependency has no TPU/Python analog in this image, so the
+same pipeline shape is provided with lightweight, dependency-free stages
+(documented deviation — swap in a real tagger by passing ``lemmatize``/
+``ner_replace`` callables):
+
+- rule-based English suffix lemmatizer (plural/verb/comparative stripping),
+- capitalized-token NER replacement with an ``ENTITY`` placeholder,
+- n-grams of the result.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable
+
+from keystone_tpu.core.pipeline import Transformer
+from keystone_tpu.core.treenode import static_field, treenode
+from keystone_tpu.ops.nlp import NGramsFeaturizer, Tokenizer
+
+
+def default_lemmatize(token: str) -> str:
+    """Tiny rule-based lemmatizer (suffix stripping)."""
+    for suffix, repl, min_len in (
+        ("sses", "ss", 5),
+        ("ies", "y", 4),
+        ("ing", "", 5),
+        ("edly", "", 6),
+        ("ed", "", 4),
+        ("s", "", 4),
+    ):
+        if token.endswith(suffix) and len(token) >= min_len:
+            return token[: len(token) - len(suffix)] + repl
+    return token
+
+
+def default_ner_replace(token: str) -> str:
+    """Replace capitalized (non-sentence-initial handling omitted) tokens."""
+    if token[:1].isupper() and token[1:].islower() and len(token) > 1:
+        return "ENTITY"
+    return token
+
+
+@treenode
+class CoreNLPFeatureExtractor(Transformer):
+    """Documents → n-grams of lemmatized, NER-replaced tokens."""
+
+    orders: tuple = static_field(default=(1, 2))
+    lemmatize: Callable[[str], str] = static_field(default=default_lemmatize)
+    ner_replace: Callable[[str], str] = static_field(default=default_ner_replace)
+
+    def __call__(self, batch):
+        tokens = Tokenizer()(batch)
+        processed = [
+            [self.lemmatize(self.ner_replace(t)) for t in doc] for doc in tokens
+        ]
+        lowered = [[t.lower() for t in doc] for doc in processed]
+        return NGramsFeaturizer(orders=self.orders)(lowered)

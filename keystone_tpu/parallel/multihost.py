@@ -1,0 +1,63 @@
+"""Multi-host (multi-process) execution helpers.
+
+The reference scales out with spark-submit + EC2 provisioning scripts
+(``bin/pipelines-ec2.sh``); the TPU-native equivalent is JAX multi-process:
+every host runs the same program, ``jax.distributed.initialize`` wires the
+processes into one runtime, and global arrays are assembled from
+process-local shards. Collectives ride ICI within a slice and DCN across
+slices — the mesh construction in :mod:`keystone_tpu.parallel.mesh` is
+unchanged because ``jax.devices()`` spans all hosts after initialization.
+
+Typical launch (one command per host, e.g. via ``gcloud compute tpus ...
+ssh --worker=all``):
+
+    python -c "import keystone_tpu.parallel.multihost as mh; mh.initialize()" \
+        && python -m keystone_tpu <pipeline> ...
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from keystone_tpu.core.logging import get_logger
+
+logger = get_logger("keystone_tpu.parallel.multihost")
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Join this process into the multi-host runtime.
+
+    With TPU VMs all arguments are discovered from the environment
+    (``jax.distributed.initialize()`` no-arg form); explicit values support
+    CPU/GPU test rigs.
+    """
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs = dict(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    jax.distributed.initialize(**kwargs)
+    logger.info(
+        "multihost: process %d/%d, %d local / %d global devices",
+        jax.process_index(),
+        jax.process_count(),
+        jax.local_device_count(),
+        jax.device_count(),
+    )
+
+
+def global_batch_from_local(local_batch: np.ndarray, mesh, ndim: int | None = None):
+    """Assemble a global data-sharded array from this process's local rows
+    (the successor of per-executor RDD partitions; wraps
+    ``jax.make_array_from_process_local_data``)."""
+    from keystone_tpu.parallel.mesh import data_sharding
+
+    sharding = data_sharding(mesh, ndim or local_batch.ndim)
+    return jax.make_array_from_process_local_data(sharding, local_batch)

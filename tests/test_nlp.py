@@ -170,3 +170,28 @@ def test_stupid_backoff_pipeline_synthetic():
     for ngram in list(model.ngram_counts)[:200]:
         s = model.score(ngram)
         assert 0 < s <= 1.0
+
+
+def test_corenlp_equivalent_extractor():
+    from keystone_tpu.ops.corenlp import CoreNLPFeatureExtractor
+
+    out = CoreNLPFeatureExtractor(orders=(1, 2))(
+        ["John was running to the stores"]
+    )[0]
+    # NER replace (John -> ENTITY), lemmatize (running -> runn? no: run),
+    # lowercase
+    flat = {g for g in out if len(g) == 1}
+    assert ("entity",) in flat
+    assert ("run",) in flat or ("runn",) in flat
+    assert ("store",) in flat
+
+
+def test_stats_helpers():
+    from keystone_tpu.utils.stats import about_eq, classification_error
+
+    assert about_eq([1.0, 2.0], [1.0, 2.0 + 1e-10])
+    assert not about_eq(1.0, 1.1)
+    topk = np.asarray([[1, 2], [0, 3], [4, 5]])
+    actual = np.asarray([2, 1, 4])
+    assert abs(classification_error(topk, actual) - 1 / 3) < 1e-9
+    assert abs(classification_error(topk, actual, k=1) - 2 / 3) < 1e-9
