@@ -49,14 +49,23 @@ PIPELINES = {
 
 def main(argv: list[str] | None = None) -> None:
     argv = list(sys.argv[1:] if argv is None else argv)
+    multihost = "--multihost" in argv
+    if multihost:
+        argv.remove("--multihost")
     if not argv or argv[0] in ("-h", "--help"):
         names = "\n  ".join(sorted(PIPELINES))
         raise SystemExit(
-            f"usage: python -m keystone_tpu <pipeline> [args...]\n"
+            f"usage: python -m keystone_tpu [--multihost] <pipeline> [args...]\n"
             f"pipelines:\n  {names}\n"
             f"(reference class names like pipelines.images.mnist.MnistRandomFFT"
-            f" are also accepted)"
+            f" are also accepted; --multihost joins this process into the\n"
+            f" jax.distributed runtime before dispatch — run the same command"
+            f" on every host)"
         )
+    if multihost:
+        from keystone_tpu.parallel import multihost as mh
+
+        mh.initialize()
     name, rest = argv[0], argv[1:]
     target = None
     if name in PIPELINES:

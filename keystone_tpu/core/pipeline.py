@@ -214,12 +214,12 @@ class Estimator:
     ``est.then(t)`` defers composition: the fitted model is followed by ``t``.
     """
 
-    def fit(self, data) -> Transformer:
+    def fit(self, data, **kw) -> Transformer:
         raise NotImplementedError
 
-    def fit_pipeline(self, data) -> Pipeline:
+    def fit_pipeline(self, data, **kw) -> Pipeline:
         """Fit and wrap the result as a single-node pipeline."""
-        return Pipeline.of(self.fit(data))
+        return Pipeline.of(self.fit(data, **kw))
 
     def then(self, nxt) -> "Estimator":
         return _SuffixedEstimator(est=self, suffix=_as_transformer(nxt))
@@ -235,7 +235,7 @@ class LabelEstimator:
     ``LabelEstimator[I,O,L]``).
     """
 
-    def fit(self, data, labels) -> Transformer:
+    def fit(self, data, labels, **kw) -> Transformer:
         raise NotImplementedError
 
     def then(self, nxt) -> "LabelEstimator":
@@ -249,16 +249,16 @@ class LabelEstimator:
 class FnEstimator(Estimator):
     fn: Callable[[Any], Transformer] = static_field()
 
-    def fit(self, data) -> Transformer:
-        return self.fn(data)
+    def fit(self, data, **kw) -> Transformer:
+        return self.fn(data, **kw)
 
 
 @treenode
 class FnLabelEstimator(LabelEstimator):
     fn: Callable[[Any, Any], Transformer] = static_field()
 
-    def fit(self, data, labels) -> Transformer:
-        return self.fn(data, labels)
+    def fit(self, data, labels, **kw) -> Transformer:
+        return self.fn(data, labels, **kw)
 
 
 def estimator(fn: Callable[[Any], Transformer]) -> Estimator:
@@ -277,8 +277,8 @@ class _SuffixedEstimator(Estimator):
     est: Estimator
     suffix: Transformer
 
-    def fit(self, data) -> Pipeline:
-        return Pipeline.of(self.est.fit(data), self.suffix)
+    def fit(self, data, **kw) -> Pipeline:
+        return Pipeline.of(self.est.fit(data, **kw), self.suffix)
 
 
 @treenode
@@ -286,8 +286,8 @@ class _SuffixedLabelEstimator(LabelEstimator):
     est: LabelEstimator
     suffix: Transformer
 
-    def fit(self, data, labels) -> Pipeline:
-        return Pipeline.of(self.est.fit(data, labels), self.suffix)
+    def fit(self, data, labels, **kw) -> Pipeline:
+        return Pipeline.of(self.est.fit(data, labels, **kw), self.suffix)
 
 
 @treenode
@@ -300,8 +300,8 @@ class ChainedEstimator(Estimator):
     prefix: Transformer
     est: Estimator
 
-    def fit(self, data) -> Pipeline:
-        model = self.est.fit(self.prefix(data))
+    def fit(self, data, **kw) -> Pipeline:
+        model = self.est.fit(self.prefix(data), **kw)
         return Pipeline.of(self.prefix, model)
 
 
@@ -312,8 +312,8 @@ class ChainedLabelEstimator(LabelEstimator):
     prefix: Transformer
     est: LabelEstimator
 
-    def fit(self, data, labels) -> Pipeline:
-        model = self.est.fit(self.prefix(data), labels)
+    def fit(self, data, labels, **kw) -> Pipeline:
+        model = self.est.fit(self.prefix(data), labels, **kw)
         return Pipeline.of(self.prefix, model)
 
 

@@ -15,7 +15,6 @@ same pipeline shape is provided with lightweight, dependency-free stages
 
 from __future__ import annotations
 
-import re
 from typing import Callable
 
 from keystone_tpu.core.pipeline import Transformer

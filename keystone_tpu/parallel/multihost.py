@@ -8,11 +8,11 @@ process-local shards. Collectives ride ICI within a slice and DCN across
 slices — the mesh construction in :mod:`keystone_tpu.parallel.mesh` is
 unchanged because ``jax.devices()`` spans all hosts after initialization.
 
-Typical launch (one command per host, e.g. via ``gcloud compute tpus ...
-ssh --worker=all``):
+Typical launch (the SAME command on every host, e.g. via ``gcloud compute
+tpus ... ssh --worker=all``; ``initialize()`` must run inside the process
+that executes the pipeline, which is what the launcher flag does):
 
-    python -c "import keystone_tpu.parallel.multihost as mh; mh.initialize()" \
-        && python -m keystone_tpu <pipeline> ...
+    python -m keystone_tpu --multihost <pipeline> ...
 """
 
 from __future__ import annotations
