@@ -29,6 +29,13 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def _flash_default() -> bool:
+    """Fused Pallas kernels by default on real TPU hardware only."""
+    from keystone_tpu.ops.flash_attention import on_tpu
+
+    return on_tpu()
+
+
 def dense_attention(q, k, v, *, causal: bool = False):
     """Reference multi-head attention. q,k,v: (B, H, S, D)."""
     scale = 1.0 / math.sqrt(q.shape[-1])
@@ -41,10 +48,15 @@ def dense_attention(q, k, v, *, causal: bool = False):
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
-def _ring_attention_shard(q, k, v, *, axis_name: str, causal: bool):
+def _ring_attention_shard(
+    q, k, v, *, axis_name: str, causal: bool, use_flash: bool
+):
     """Per-shard ring attention body (runs under shard_map).
 
-    q, k, v: (B, H, S_local, D) — this chip's sequence shard.
+    q, k, v: (B, H, S_local, D) — this chip's sequence shard. With
+    ``use_flash`` the per-hop blockwise update runs as the fused Pallas
+    kernel (:func:`keystone_tpu.ops.flash_attention.flash_attention_step`);
+    the K/V rotation stays an XLA ``ppermute`` over ICI either way.
     """
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
@@ -52,6 +64,33 @@ def _ring_attention_shard(q, k, v, *, axis_name: str, causal: bool):
     scale = 1.0 / math.sqrt(d)
 
     q_pos = idx * s_local + jnp.arange(s_local)  # global query positions
+
+    if use_flash:
+        from keystone_tpu.ops.flash_attention import flash_attention_step
+
+        m = jnp.full((b, h, s_local), -1e30, jnp.float32)
+        l = jnp.zeros((b, h, s_local), jnp.float32)
+        acc = jnp.zeros((b, h, s_local, d), jnp.float32)
+        k_blk, v_blk = k, v
+        for step in range(n):
+            owner = (idx - step) % n
+            m, l, acc = flash_attention_step(
+                q,
+                k_blk,
+                v_blk,
+                m,
+                l,
+                acc,
+                q_offset=idx * s_local,
+                k_offset=owner * s_local,
+                causal=causal,
+            )
+            if step + 1 < n:
+                perm = [(j, (j + 1) % n) for j in range(n)]
+                k_blk = lax.ppermute(k_blk, axis_name, perm)
+                v_blk = lax.ppermute(v_blk, axis_name, perm)
+        out = acc / jnp.maximum(l, 1e-30)[..., None]
+        return out.astype(q.dtype)
 
     m = jnp.full((b, h, s_local, 1), -jnp.inf, q.dtype)
     l = jnp.zeros((b, h, s_local, 1), q.dtype)
@@ -91,26 +130,40 @@ def ring_attention(
     *,
     seq_axis: str = "data",
     causal: bool = False,
+    use_flash: bool | None = None,
 ):
     """Exact attention with the sequence axis sharded over ``seq_axis``.
 
     q, k, v: (B, H, S, D) global arrays (S divisible by the axis size).
+    ``use_flash`` selects the fused Pallas per-hop kernel (default: on
+    when running on TPU).
     """
+    if use_flash is None:
+        use_flash = _flash_default()
     spec = P(None, None, seq_axis, None)
     fn = jax.shard_map(
-        partial(_ring_attention_shard, axis_name=seq_axis, causal=causal),
+        partial(
+            _ring_attention_shard,
+            axis_name=seq_axis,
+            causal=causal,
+            use_flash=use_flash,
+        ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        # pallas_call outputs carry no varying-mesh-axis metadata; skip the
+        # vma consistency check on the flash path
+        check_vma=not use_flash,
     )
     return fn(q, k, v)
 
 
-def _ulysses_shard(q, k, v, *, axis_name: str, causal: bool):
+def _ulysses_shard(q, k, v, *, axis_name: str, causal: bool, use_flash: bool):
     """All-to-all sequence↔head resharding (DeepSpeed-Ulysses style).
 
     In: (B, H, S_local, D) sequence-sharded → all_to_all → (B, H/n, S, D)
-    head-sharded → dense attention → all_to_all back.
+    head-sharded → local attention over the full sequence (fused Pallas
+    flash kernel on TPU, dense jnp otherwise) → all_to_all back.
     """
 
     def seq_to_heads(x):
@@ -125,7 +178,12 @@ def _ulysses_shard(q, k, v, *, axis_name: str, causal: bool):
         )
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    out = dense_attention(qh, kh, vh, causal=causal)
+    if use_flash:
+        from keystone_tpu.ops.flash_attention import flash_attention
+
+        out = flash_attention(qh, kh, vh, causal=causal)
+    else:
+        out = dense_attention(qh, kh, vh, causal=causal)
     return heads_to_seq(out)
 
 
@@ -137,20 +195,29 @@ def ulysses_attention(
     *,
     seq_axis: str = "data",
     causal: bool = False,
+    use_flash: bool | None = None,
 ):
     """Exact attention via all-to-all head/sequence resharding.
 
     Requires H divisible by the axis size. Prefers ICI bandwidth over ring
     latency — the usual pick when heads are plentiful.
     """
+    if use_flash is None:
+        use_flash = _flash_default()
     n = mesh.shape[seq_axis]
     if q.shape[1] % n:
         raise ValueError(f"heads ({q.shape[1]}) not divisible by axis ({n})")
     spec = P(None, None, seq_axis, None)
     fn = jax.shard_map(
-        partial(_ulysses_shard, axis_name=seq_axis, causal=causal),
+        partial(
+            _ulysses_shard,
+            axis_name=seq_axis,
+            causal=causal,
+            use_flash=use_flash,
+        ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        check_vma=not use_flash,
     )
     return fn(q, k, v)

@@ -1,0 +1,462 @@
+"""Pallas TPU flash attention — fused blockwise attention kernels.
+
+The jnp attention in :mod:`keystone_tpu.ops.attention` materializes the
+(S_q, S_k) score matrix in HBM; on TPU the arithmetic intensity of
+attention is set by how much of that traffic can stay in VMEM. These
+kernels fuse the score gemm, online softmax, and value gemm into one
+VMEM-resident pass (flash-attention schedule):
+
+- :func:`flash_attention` — full attention, grid over (batch*heads,
+  query blocks), K/V streamed through VMEM block by block with a running
+  (max, sum, accumulator) online softmax.
+- :func:`flash_attention_step` — one K/V block's contribution with the
+  online-softmax state (m, l, acc) carried in and out. This is the fused
+  inner step of ring attention: the ring loop keeps K/V rotating via
+  ``ppermute`` (XLA collectives over ICI) and calls this kernel per hop.
+
+Both run compiled on TPU and in Pallas interpret mode elsewhere (the
+8-device CPU test mesh), selected automatically. Numerics: scores and the
+online-softmax state are always float32; masked positions use a large
+negative finite constant so no ±inf arithmetic appears in the kernel.
+
+Reference: the reference framework has no attention (SURVEY.md §5 — out of
+scope for parity); this is part of the beyond-parity long-context stack.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG = -1e30  # masked-score value: exp(_NEG - m) underflows to exactly 0
+_LANE = 128
+_KV_VMEM_BUDGET = 8 * 1024 * 1024  # K+V bytes above which K/V is streamed
+
+
+def on_tpu() -> bool:
+    """True on real TPU hardware (the axon platform is a TPU behind a
+    tunnel) — selects compiled Pallas vs interpret mode and the
+    flash-by-default policy in :mod:`keystone_tpu.ops.attention`."""
+    return jax.default_backend() in ("tpu", "axon")
+
+
+_on_tpu = on_tpu  # internal alias
+
+
+def _pad_to(x, axis: int, mult: int, value=0.0):
+    size = x.shape[axis]
+    pad = (-size) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths, constant_values=value)
+
+
+def _flash_kernel_fori(
+    scalars_ref,  # (3,) int32: [s_k_valid, q_offset, k_offset]
+    q_ref,  # (1, block_q, d)
+    k_ref,  # (1, s_k_pad, d) — K/V resident in VMEM for this head
+    v_ref,
+    o_ref,  # (1, block_q, d)
+    *,
+    scale: float,
+    block_k: int,
+    causal: bool,
+):
+    """K/V-resident variant: one program per q block, fori over K blocks.
+
+    Faster than grid-streaming K when K/V fit VMEM (no per-step grid
+    overhead, no scratch churn); selected automatically by size.
+    """
+    block_q, d = q_ref.shape[1], q_ref.shape[2]
+    num_k = k_ref.shape[1] // block_k
+
+    s_k_valid = scalars_ref[0]
+    q_start = scalars_ref[1] + pl.program_id(1) * block_q
+    q_pos = q_start + lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+    if causal:
+        # skip K blocks entirely above the diagonal (dense attention pays
+        # compute for the full rectangle)
+        num_k_live = jnp.clip(
+            (q_start + block_q - scalars_ref[2] + block_k - 1) // block_k,
+            0,
+            num_k,
+        )
+    else:
+        num_k_live = num_k
+
+    q = q_ref[0] * jnp.asarray(scale, q_ref.dtype)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        k_pos = (
+            scalars_ref[2]
+            + j * block_k
+            + lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+        )
+        valid = k_pos < s_k_valid
+        if causal:
+            valid = jnp.logical_and(valid, q_pos >= k_pos)
+        s = jnp.where(valid, s, _NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        # explicit zero on masked lanes: when a row is fully masked m_new
+        # stays at the _NEG init and exp(s - m_new) alone would be 1
+        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.dot(
+            p.astype(v_blk.dtype), v_blk, preferred_element_type=jnp.float32
+        )
+        return m_new, l, acc
+
+    m0 = jnp.full((block_q, 1), _NEG, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    _, l, acc = lax.fori_loop(0, num_k_live, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_kernel_stream(
+    scalars_ref,  # (3,) int32: [s_k_valid, q_offset, k_offset]
+    q_ref,  # (1, block_q, d)
+    k_ref,  # (1, block_k, d) — streamed via the sequential grid dim
+    v_ref,
+    o_ref,  # (1, block_q, d)
+    m_scr,  # (block_q, LANE) f32 — online-softmax state, lives across
+    l_scr,  # the sequential K grid dimension
+    acc_scr,  # (block_q, d) f32
+    *,
+    scale: float,
+    causal: bool,
+):
+    block_q, d = q_ref.shape[1], q_ref.shape[2]
+    block_k = k_ref.shape[1]
+    kk = pl.program_id(2)
+    num_k = pl.num_programs(2)
+
+    s_k_valid = scalars_ref[0]
+    q_start = scalars_ref[1] + pl.program_id(1) * block_q
+    k_start = scalars_ref[2] + kk * block_k
+
+    @pl.when(kk == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # K blocks entirely above the causal diagonal contribute nothing; the
+    # pipeline still streams them but the MXU work is skipped (dense
+    # attention pays compute for the full rectangle)
+    live = k_start < q_start + block_q if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0] * jnp.asarray(scale, q_ref.dtype)
+        k_blk, v_blk = k_ref[0], v_ref[0]
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        q_pos = q_start + lax.broadcasted_iota(
+            jnp.int32, (block_q, 1), 0
+        )
+        k_pos = k_start + lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+        valid = k_pos < s_k_valid
+        if causal:
+            valid = jnp.logical_and(valid, q_pos >= k_pos)
+        s = jnp.where(valid, s, _NEG)
+        m = m_scr[:, :1]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l = l_scr[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jnp.dot(
+            p.astype(v_blk.dtype), v_blk, preferred_element_type=jnp.float32
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l, l_scr.shape)
+
+    @pl.when(kk == num_k - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        o_ref[0] = (acc_scr[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    *,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    q_offset=0,
+    k_offset=0,
+    mxu_dtype=None,
+    interpret: bool | None = None,
+):
+    """Fused attention. q: (B, H, S_q, D); k, v: (B, H, S_k, D).
+
+    ``mxu_dtype=jnp.bfloat16`` feeds the two gemms bf16 inputs (float32
+    accumulation and softmax state) for ~2x MXU rate at ~1e-3 output
+    error; default None keeps the gemms in the input precision.
+
+    ``q_offset``/``k_offset`` give the global positions of the local q/k
+    windows for causal masking (used when sequence shards carry different
+    ranges, e.g. under Ulysses head-sharding the offsets stay 0 because
+    each chip sees full sequences). Exact (== dense softmax attention).
+    """
+    if interpret is None:
+        interpret = not _on_tpu()
+    b, h, s_q, d = q.shape
+    s_k = k.shape[2]
+    scale = 1.0 / math.sqrt(d)
+    out_dtype = q.dtype
+
+    block_q = min(block_q, max(s_q, 8))
+    block_k = min(block_k, max(s_k, 8))
+
+    if mxu_dtype is not None:
+        # cast on the XLA side: halves the K/V HBM→VMEM stream for bf16
+        q, k, v = (x.astype(mxu_dtype) for x in (q, k, v))
+    qf = _pad_to(q.reshape(b * h, s_q, d), 1, block_q)
+    kf = _pad_to(k.reshape(b * h, s_k, d), 1, block_k)
+    vf = _pad_to(v.reshape(b * h, s_k, d), 1, block_k)
+    # zero-padding D is free: extra K columns don't change scores, extra V
+    # columns produce zero output columns that are sliced away
+    qf = _pad_to(qf, 2, _LANE)
+    kf = _pad_to(kf, 2, _LANE)
+    vf = _pad_to(vf, 2, _LANE)
+    s_q_pad, d_pad = qf.shape[1], qf.shape[2]
+    s_k_pad = kf.shape[1]
+
+    scalars = jnp.array([s_k + k_offset, q_offset, k_offset], jnp.int32)
+    kv_bytes = 2 * s_k_pad * d_pad * kf.dtype.itemsize
+    if kv_bytes <= _KV_VMEM_BUDGET:
+        # K/V resident in VMEM per program — lowest overhead
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b * h, s_q_pad // block_q),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d_pad), lambda i, j, *_: (i, j, 0)),
+                pl.BlockSpec((1, s_k_pad, d_pad), lambda i, j, *_: (i, 0, 0)),
+                pl.BlockSpec((1, s_k_pad, d_pad), lambda i, j, *_: (i, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, block_q, d_pad), lambda i, j, *_: (i, j, 0)
+            ),
+        )
+        kernel = functools.partial(
+            _flash_kernel_fori, scale=scale, block_k=block_k, causal=causal
+        )
+        compiler_params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        )
+    else:
+        # long-context: stream K/V block-by-block through the pipelined
+        # sequential grid dimension, state in VMEM scratch
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b * h, s_q_pad // block_q, s_k_pad // block_k),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, block_q, d_pad), lambda i, j, kk, *_: (i, j, 0)
+                ),
+                pl.BlockSpec(
+                    (1, block_k, d_pad), lambda i, j, kk, *_: (i, kk, 0)
+                ),
+                pl.BlockSpec(
+                    (1, block_k, d_pad), lambda i, j, kk, *_: (i, kk, 0)
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, block_q, d_pad), lambda i, j, kk, *_: (i, j, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((block_q, _LANE), jnp.float32),
+                pltpu.VMEM((block_q, _LANE), jnp.float32),
+                pltpu.VMEM((block_q, d_pad), jnp.float32),
+            ],
+        )
+        kernel = functools.partial(
+            _flash_kernel_stream, scale=scale, causal=causal
+        )
+        compiler_params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b * h, s_q_pad, d_pad), out_dtype),
+        compiler_params=compiler_params,
+        interpret=interpret,
+    )(scalars, qf, kf, vf)
+    return out[:, :s_q, :d].reshape(b, h, s_q, d)
+
+
+def _flash_step_kernel(
+    scalars_ref,  # (3,) int32: [q_offset, k_offset, valid-K end]
+    q_ref,  # (1, block_q, d)
+    k_ref,  # (1, s_k, d)
+    v_ref,  # (1, s_k, d)
+    m_ref,  # (1, block_q, LANE) broadcast state
+    l_ref,
+    acc_ref,  # (1, block_q, d)
+    m_out,
+    l_out,
+    acc_out,
+    *,
+    scale: float,
+    block_k: int,
+    causal: bool,
+):
+    block_q, d = q_ref.shape[1], q_ref.shape[2]
+    num_k = k_ref.shape[1] // block_k
+
+    q = q_ref[0].astype(jnp.float32) * scale
+    q_start = scalars_ref[0] + pl.program_id(1) * block_q
+    q_pos = q_start + lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+    if causal:
+        num_k_live = jnp.clip(
+            (q_start + block_q - scalars_ref[1] + block_k - 1) // block_k,
+            0,
+            num_k,
+        )
+    else:
+        num_k_live = num_k
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        k_pos = (
+            scalars_ref[1]
+            + j * block_k
+            + lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+        )
+        valid = k_pos < scalars_ref[2]  # mask zero-padded K positions
+        if causal:
+            valid = jnp.logical_and(valid, q_pos >= k_pos)
+        s = jnp.where(valid, s, _NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        # explicit zero on masked lanes: when a row is fully masked m_new
+        # stays at the _NEG init and exp(s - m_new) alone would be 1
+        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.dot(
+            p, v_blk, preferred_element_type=jnp.float32
+        )
+        return m_new, l, acc
+
+    m0 = m_ref[0, :, :1]
+    l0 = l_ref[0, :, :1]
+    m, l, acc = lax.fori_loop(0, num_k_live, body, (m0, l0, acc_ref[0]))
+    m_out[0] = jnp.broadcast_to(m, (block_q, m_out.shape[2]))
+    l_out[0] = jnp.broadcast_to(l, (block_q, l_out.shape[2]))
+    acc_out[0] = acc
+
+
+def flash_attention_step(
+    q,
+    k_blk,
+    v_blk,
+    m,
+    l,
+    acc,
+    *,
+    q_offset,
+    k_offset,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+):
+    """One fused online-softmax update: attend q over a single K/V block.
+
+    State: m, l of shape (B, H, S_q) and acc of shape (B, H, S_q, D),
+    always float32 (initialize m to a large negative value, l and acc to
+    zeros). Returns updated (m, l, acc); finalize with ``acc / l``. The
+    offsets are the *global* sequence positions of the q and k windows —
+    traced values are fine (ring attention passes axis_index-derived
+    offsets). Shards that don't tile evenly into blocks are zero-padded
+    (padded K positions are masked; padded q rows are sliced away).
+    """
+    if interpret is None:
+        interpret = not _on_tpu()
+    b, h, s_q, d = q.shape
+    s_k = k_blk.shape[2]
+    scale = 1.0 / math.sqrt(d)
+    block_q = min(block_q, max(s_q, 8))
+    block_k = min(block_k, max(s_k, 8))
+
+    qf = _pad_to(q.reshape(b * h, s_q, d), 1, block_q)
+    kf = _pad_to(k_blk.reshape(b * h, s_k, d), 1, block_k)
+    vf = _pad_to(v_blk.reshape(b * h, s_k, d), 1, block_k)
+    qf = _pad_to(qf, 2, _LANE)
+    kf = _pad_to(kf, 2, _LANE)
+    vf = _pad_to(vf, 2, _LANE)
+    s_q_pad, d_pad = qf.shape[1], qf.shape[2]
+    s_k_pad = kf.shape[1]
+    # state rides as (BH, S_q, LANE)/(BH, S_q, d_pad) VMEM-tiled arrays
+    mf = _pad_to(
+        jnp.broadcast_to(
+            m.reshape(b * h, s_q, 1), (b * h, s_q, _LANE)
+        ).astype(jnp.float32),
+        1,
+        block_q,
+    )
+    lf = _pad_to(
+        jnp.broadcast_to(
+            l.reshape(b * h, s_q, 1), (b * h, s_q, _LANE)
+        ).astype(jnp.float32),
+        1,
+        block_q,
+    )
+    accf = _pad_to(
+        _pad_to(acc.reshape(b * h, s_q, d), 2, _LANE).astype(jnp.float32),
+        1,
+        block_q,
+    )
+
+    scalars = jnp.stack(
+        [
+            jnp.asarray(q_offset, jnp.int32),
+            jnp.asarray(k_offset, jnp.int32),
+            jnp.asarray(k_offset + s_k, jnp.int32),  # valid-K end
+        ]
+    )
+    qspec = pl.BlockSpec((1, block_q, d_pad), lambda i, j, *_: (i, j, 0))
+    kspec = pl.BlockSpec((1, s_k_pad, d_pad), lambda i, j, *_: (i, 0, 0))
+    sspec = pl.BlockSpec((1, block_q, _LANE), lambda i, j, *_: (i, j, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b * h, s_q_pad // block_q),
+        in_specs=[qspec, kspec, kspec, sspec, sspec, qspec],
+        out_specs=(sspec, sspec, qspec),
+    )
+    m2, l2, acc2 = pl.pallas_call(
+        functools.partial(
+            _flash_step_kernel, scale=scale, block_k=block_k, causal=causal
+        ),
+        grid_spec=grid_spec,
+        out_shape=(
+            jax.ShapeDtypeStruct((b * h, s_q_pad, _LANE), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, s_q_pad, _LANE), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, s_q_pad, d_pad), jnp.float32),
+        ),
+        interpret=interpret,
+    )(scalars, qf, kf, vf, mf, lf, accf)
+    return (
+        m2[:, :s_q, 0].reshape(b, h, s_q),
+        l2[:, :s_q, 0].reshape(b, h, s_q),
+        acc2[:, :s_q, :d].reshape(b, h, s_q, d),
+    )

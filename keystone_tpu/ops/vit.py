@@ -20,7 +20,11 @@ import jax.numpy as jnp
 
 from keystone_tpu.core.pipeline import Transformer
 from keystone_tpu.core.treenode import static_field, treenode
-from keystone_tpu.ops.attention import dense_attention, ring_attention
+from keystone_tpu.ops.attention import (
+    _flash_default,
+    dense_attention,
+    ring_attention,
+)
 from keystone_tpu.ops.images import extract_patches
 
 
@@ -74,6 +78,10 @@ class ViTFeaturizer(Transformer):
         q, k, v = split(blk.wq), split(blk.wk), split(blk.wv)
         if self.mesh is not None:
             out = ring_attention(q, k, v, self.mesh, seq_axis=self.seq_axis)
+        elif _flash_default():
+            from keystone_tpu.ops.flash_attention import flash_attention
+
+            out = flash_attention(q, k, v)
         else:
             out = dense_attention(q, k, v)
         out = out.transpose(0, 2, 1, 3).reshape(n, s, d)

@@ -1,0 +1,161 @@
+"""Pallas flash-attention kernels must equal dense attention.
+
+Runs in Pallas interpret mode on the CPU test mesh (the compiled path uses
+the identical kernel body on TPU). Covers the full kernel (padding, causal,
+cross-attention shapes), the online-softmax step kernel, and the fused
+paths inside ring / Ulysses attention.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu.ops.attention import (
+    dense_attention,
+    ring_attention,
+    ulysses_attention,
+)
+from keystone_tpu.ops.flash_attention import (
+    flash_attention,
+    flash_attention_step,
+)
+
+
+def _qkv(rng, b=2, h=3, s=64, d=32, s_k=None):
+    def one(s_):
+        return jnp.asarray(rng.normal(size=(b, h, s_, d)).astype(np.float32))
+
+    return one(s), one(s_k or s), one(s_k or s)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_equals_dense(rng, causal):
+    q, k, v = _qkv(rng)
+    ref = dense_attention(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_unaligned_shapes(rng):
+    """S and D not multiples of the block/lane sizes — padding is masked."""
+    q, k, v = _qkv(rng, b=1, h=2, s=100, d=40)
+    ref = dense_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_cross_attention(rng):
+    """S_q != S_k (decoder-style cross attention)."""
+    q, k, v = _qkv(rng, s=32, s_k=96)
+    ref = dense_attention(q, k, v)
+    out = flash_attention(q, k, v, block_q=32, block_k=32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_under_jit(rng):
+    q, k, v = _qkv(rng, s=128, d=64)
+    ref = dense_attention(q, k, v, causal=True)
+    out = jax.jit(lambda a, b, c: flash_attention(a, b, c, causal=True))(
+        q, k, v
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_step_accumulates_to_dense(rng):
+    """Feeding K/V block by block through the step kernel == full softmax —
+    the exactness invariant ring attention relies on."""
+    b, h, s, d = 1, 2, 128, 64
+    q, k, v = _qkv(rng, b=b, h=h, s=s, d=d)
+    nblk, sk = 4, s // 4
+    m = jnp.full((b, h, s), -1e30, jnp.float32)
+    l = jnp.zeros((b, h, s), jnp.float32)
+    acc = jnp.zeros((b, h, s, d), jnp.float32)
+    for j in range(nblk):
+        m, l, acc = flash_attention_step(
+            q,
+            k[:, :, j * sk : (j + 1) * sk],
+            v[:, :, j * sk : (j + 1) * sk],
+            m,
+            l,
+            acc,
+            q_offset=0,
+            k_offset=j * sk,
+            causal=True,
+            block_q=64,
+            block_k=32,
+        )
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    ref = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_fully_masked_rows_are_zero(rng):
+    """A causal q window strictly before the k window: every row is fully
+    masked and must output exactly 0 (not the mean of V)."""
+    q, k, v = _qkv(rng, b=1, h=1, s=64, d=32)
+    out = flash_attention(
+        q, k, v, causal=True, q_offset=0, k_offset=64, block_q=64, block_k=64
+    )
+    assert float(jnp.max(jnp.abs(out))) == 0.0
+
+
+def test_flash_step_uneven_shard(rng):
+    """Shard length not divisible by the block size — padded and masked."""
+    b, h, s, d = 1, 2, 192, 24
+    q, k, v = _qkv(rng, b=b, h=h, s=s, d=d)
+    m = jnp.full((b, h, s), -1e30, jnp.float32)
+    l = jnp.zeros((b, h, s), jnp.float32)
+    acc = jnp.zeros((b, h, s, d), jnp.float32)
+    m, l, acc = flash_attention_step(
+        q, k, v, m, l, acc, q_offset=0, k_offset=0, causal=True
+    )
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    ref = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_streaming_variant(rng, monkeypatch):
+    """Force the long-context K/V-streaming kernel and compare to dense."""
+    import keystone_tpu.ops.flash_attention as fa
+
+    monkeypatch.setattr(fa, "_KV_VMEM_BUDGET", 1)
+    q, k, v = _qkv(rng, b=1, h=2, s=256, d=64)
+    for causal in (False, True):
+        out = fa.flash_attention(
+            q, k, v, causal=causal, block_q=64, block_k=64
+        )
+        ref = dense_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=2e-5
+        )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_equals_dense(mesh8, rng, causal):
+    q, k, v = _qkv(rng, s=64, d=16)
+    ref = dense_attention(q, k, v, causal=causal)
+    out = ring_attention(
+        q, k, v, mesh8, seq_axis="data", causal=causal, use_flash=True
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ulysses_flash_equals_dense(mesh8, rng):
+    q, k, v = _qkv(rng, h=8, s=64, d=16)
+    ref = dense_attention(q, k, v, causal=True)
+    out = ulysses_attention(
+        q, k, v, mesh8, seq_axis="data", causal=True, use_flash=True
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_flash_under_jit_long_sequence(mesh8, rng):
+    q, k, v = _qkv(rng, b=1, h=2, s=1024, d=8)
+    ref = dense_attention(q, k, v)
+    out = jax.jit(
+        lambda a, b, c: ring_attention(
+            a, b, c, mesh8, seq_axis="data", use_flash=True
+        )
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
