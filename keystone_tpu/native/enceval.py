@@ -1,0 +1,158 @@
+"""XLA FFI bindings for the native GMM-EM / Fisher-vector host kernels.
+
+The reference's EncEval JNI shim (``src/main/cpp/EncEval.cxx``) runs GMM EM
+and Fisher-vector encoding in native code on the host; the parity
+equivalents here live in ``native/enceval_ffi.cpp`` and register as XLA
+CPU custom calls through :mod:`jax.ffi` (no JNI, no host round-trip
+management — XLA owns the buffers). The on-device jnp path in
+:mod:`keystone_tpu.ops.gmm` remains the fast default; both implement the
+same equations, so results agree to float tolerance and artifacts are
+interchangeable.
+
+The shared library builds on demand (``make`` in ``native/``); everything
+degrades gracefully when the toolchain, headers, or a CPU backend are
+unavailable — check :func:`available` or pass ``backend="device"``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+
+import numpy as np
+
+from keystone_tpu.core.logging import get_logger
+from keystone_tpu.native import _NATIVE_DIR, _build
+
+logger = get_logger("keystone_tpu.native.enceval")
+
+_LIB_PATH = os.path.abspath(
+    os.path.join(_NATIVE_DIR, "libkeystone_enceval.so")
+)
+
+_lock = threading.Lock()
+_available: bool | None = None
+
+
+def _cpu_device():
+    import jax
+
+    try:
+        return jax.local_devices(backend="cpu")[0]
+    except Exception:  # noqa: BLE001 — backend not configured
+        return None
+
+
+def _ensure_registered() -> bool:
+    global _available
+    with _lock:
+        if _available is not None:
+            return _available
+        _available = False
+        if not os.path.exists(_LIB_PATH) and not _build():
+            return False
+        if not os.path.exists(_LIB_PATH):
+            logger.info("libkeystone_enceval.so not built; native path off")
+            return False
+        if _cpu_device() is None:
+            logger.info("no CPU jax backend; native enceval path off")
+            return False
+        try:
+            import jax
+
+            lib = ctypes.CDLL(_LIB_PATH)
+            jax.ffi.register_ffi_target(
+                "keystone_gmm_em",
+                jax.ffi.pycapsule(lib.KeystoneGmmEm),
+                platform="cpu",
+            )
+            jax.ffi.register_ffi_target(
+                "keystone_fisher",
+                jax.ffi.pycapsule(lib.KeystoneFisher),
+                platform="cpu",
+            )
+        except Exception as e:  # noqa: BLE001
+            logger.info("ffi registration failed: %s", e)
+            return False
+        _available = True
+        return True
+
+
+def available() -> bool:
+    """True when the native kernels can be used (lib built + CPU backend)."""
+    return _ensure_registered()
+
+
+def gmm_em(x, k: int, max_iter: int = 100, seed: int = 42,
+           var_floor: float = 1e-5):
+    """Fit a diagonal GMM with the native EM kernel.
+
+    Same contract as ``keystone_tpu.ops.gmm._gmm_em`` (identical random
+    init, update equations, and (d, k) layouts); returns numpy
+    ``(means, variances, weights)``.
+    """
+    if not _ensure_registered():
+        raise RuntimeError(
+            "native enceval kernels unavailable (build native/ and ensure "
+            "a CPU jax backend); use the on-device estimator instead"
+        )
+    import jax
+    import jax.numpy as jnp
+
+    from keystone_tpu.ops.gmm import gmm_init
+
+    x = np.ascontiguousarray(np.asarray(x, np.float32))
+    n, d = x.shape
+    mu0, var0, w0 = (
+        np.ascontiguousarray(np.asarray(a))
+        for a in gmm_init(jnp.asarray(x), k, seed, var_floor)
+    )
+
+    call = jax.ffi.ffi_call(
+        "keystone_gmm_em",
+        (
+            jax.ShapeDtypeStruct((d, k), jnp.float32),
+            jax.ShapeDtypeStruct((d, k), jnp.float32),
+            jax.ShapeDtypeStruct((k,), jnp.float32),
+        ),
+    )
+    with jax.default_device(_cpu_device()):
+        mu, var, w = call(
+            x, mu0, var0, w0,
+            max_iter=np.int64(max_iter),
+            var_floor=np.float32(var_floor),
+        )
+    return np.asarray(mu), np.asarray(var), np.asarray(w)
+
+
+def fisher_vectors(batch, means, variances, weights):
+    """Fisher-vector encode (N, d, m) descriptor batches natively.
+
+    Output layout matches ``keystone_tpu.ops.gmm.FisherVector``:
+    (N, d, 2k) with mean gradients in columns 0..k-1, variance gradients
+    in k..2k-1.
+    """
+    if not _ensure_registered():
+        raise RuntimeError(
+            "native enceval kernels unavailable (build native/ and ensure "
+            "a CPU jax backend); use the on-device FisherVector instead"
+        )
+    import jax
+    import jax.numpy as jnp
+
+    batch = np.ascontiguousarray(np.asarray(batch, np.float32))
+    n, d, m = batch.shape
+    k = int(np.asarray(weights).shape[0])
+    call = jax.ffi.ffi_call(
+        "keystone_fisher",
+        jax.ShapeDtypeStruct((n, d, 2 * k), jnp.float32),
+    )
+    with jax.default_device(_cpu_device()):
+        out = call(
+            batch,
+            np.ascontiguousarray(np.asarray(means, np.float32)),
+            np.ascontiguousarray(np.asarray(variances, np.float32)),
+            np.ascontiguousarray(np.asarray(weights, np.float32)),
+        )
+    return np.asarray(out)

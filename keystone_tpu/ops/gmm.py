@@ -93,8 +93,23 @@ class GaussianMixtureModelEstimator(Estimator):
     max_iter: int = static_field(default=100)
     seed: int = static_field(default=42)
     var_floor: float = static_field(default=VAR_FLOOR)
+    # "device" = jitted jnp EM; "native" = C++ XLA FFI host kernel
+    # (native/enceval_ffi.cpp) — the EncEval.cxx parity path
+    backend: str = static_field(default="device")
 
     def fit(self, samples) -> GaussianMixtureModel:
+        if self.backend == "native":
+            from keystone_tpu.native import enceval
+
+            means, variances, weights = enceval.gmm_em(
+                np.asarray(samples), self.k, self.max_iter, self.seed,
+                self.var_floor,
+            )
+            return GaussianMixtureModel(
+                means=jnp.asarray(means),
+                variances=jnp.asarray(variances),
+                weights=jnp.asarray(weights),
+            )
         x = jnp.asarray(samples, jnp.float32)
         means, variances, weights = _gmm_em(
             x, self.k, self.max_iter, self.seed, self.var_floor
@@ -104,17 +119,23 @@ class GaussianMixtureModelEstimator(Estimator):
         )
 
 
-@partial(jax.jit, static_argnames=("k", "max_iter", "seed", "var_floor"))
-def _gmm_em(x, k: int, max_iter: int, seed: int, var_floor: float):
-    n, d = x.shape
-    key = jax.random.key(seed)
-    # random init: k distinct samples as means (the reference's random_init),
-    # global variance, uniform weights
-    idx = jax.random.choice(key, n, (k,), replace=False)
+def gmm_init(x, k: int, seed: int, var_floor: float):
+    """Deterministic EM init shared by the device and native backends:
+    k distinct samples as means (the reference's random_init), global
+    variance, uniform weights."""
+    n = x.shape[0]
+    idx = jax.random.choice(jax.random.key(seed), n, (k,), replace=False)
     mu0 = x[idx].T  # (d, k)
     global_var = jnp.maximum(jnp.var(x, axis=0), var_floor)
     var0 = jnp.tile(global_var[:, None], (1, k))
     w0 = jnp.full((k,), 1.0 / k, x.dtype)
+    return mu0, var0, w0
+
+
+@partial(jax.jit, static_argnames=("k", "max_iter", "seed", "var_floor"))
+def _gmm_em(x, k: int, max_iter: int, seed: int, var_floor: float):
+    n, d = x.shape
+    mu0, var0, w0 = gmm_init(x, k, seed, var_floor)
 
     def em_step(_, state):
         mu, var, w = state
@@ -144,8 +165,20 @@ class FisherVector(Transformer):
     """
 
     gmm: GaussianMixtureModel
+    backend: str = static_field(default="device")  # or "native" (FFI)
 
     def __call__(self, batch):
+        if self.backend == "native":
+            from keystone_tpu.native import enceval
+
+            return jnp.asarray(
+                enceval.fisher_vectors(
+                    np.asarray(batch),
+                    np.asarray(self.gmm.means),
+                    np.asarray(self.gmm.variances),
+                    np.asarray(self.gmm.weights),
+                )
+            )
         return _fisher_vectors(batch, self.gmm)
 
 

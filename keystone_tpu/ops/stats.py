@@ -217,7 +217,15 @@ def sample_columns(desc, num: int, seed: int) -> jnp.ndarray:
         idx = np.sort(
             np.random.default_rng(seed).choice(total, num, replace=False)
         )
-        flat = jnp.take(flat, jnp.asarray(idx), axis=0)
+        if jax.default_backend() == "cpu" and getattr(
+            flat, "is_fully_addressable", True
+        ):
+            # host-side gather: the index draw already lives on the host,
+            # and jax 0.9's CPU gather flakily aborts when dispatched after
+            # a multi-device shard_map run in the same process
+            flat = jnp.asarray(np.asarray(flat)[idx])
+        else:
+            flat = jnp.take(flat, jnp.asarray(idx), axis=0)
     return flat
 
 

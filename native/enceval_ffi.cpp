@@ -1,0 +1,230 @@
+// XLA FFI host kernels: diagonal-covariance GMM EM and Fisher-vector
+// encoding — the native parity components for the reference's EncEval JNI
+// shim (reference src/main/cpp/EncEval.cxx: computeGMM over
+// gaussian_mixture<float>, calcAndGetFVs over fisher<float>; SURVEY.md
+// §2.10). The on-device jnp path (keystone_tpu/ops/gmm.py) is the fast
+// default; these handlers register as CPU custom calls and mirror its
+// equations exactly so either path can fit/encode interchangeably.
+//
+// Built as libkeystone_enceval.so by native/Makefile; registered via
+// jax.ffi in keystone_tpu/native/enceval.py.
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <vector>
+
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+namespace {
+
+// log responsibilities + accumulation of the three sufficient statistics
+// for one EM pass. x: (n, d) row-major; mu/var: (d, k) column-major-by-
+// component (same layout as the jnp path's (dim, k) arrays flattened
+// row-major, i.e. x[d_i * k + k_j]).
+void em_pass(const float* x, int64_t n, int64_t d, int64_t k,
+             const float* mu, const float* var, const float* w,
+             double* nk, double* sx, double* sxx) {
+  std::vector<double> log_norm(k, 0.0);
+  for (int64_t j = 0; j < k; ++j) {
+    double s = 0.0;
+    for (int64_t i = 0; i < d; ++i) {
+      s += std::log(2.0 * M_PI * var[i * k + j]);
+    }
+    log_norm[j] = -0.5 * s + std::log(std::max((double)w[j], 1e-30));
+  }
+
+  std::fill(nk, nk + k, 0.0);
+  std::fill(sx, sx + d * k, 0.0);
+  std::fill(sxx, sxx + d * k, 0.0);
+
+#pragma omp parallel
+  {
+    std::vector<double> lp(k), gamma(k);
+    std::vector<double> nk_l(k, 0.0), sx_l(d * k, 0.0), sxx_l(d * k, 0.0);
+#pragma omp for nowait
+    for (int64_t r = 0; r < n; ++r) {
+      const float* xr = x + r * d;
+      double m = -1e300;
+      for (int64_t j = 0; j < k; ++j) {
+        double q = 0.0;
+        for (int64_t i = 0; i < d; ++i) {
+          const double diff = (double)xr[i] - (double)mu[i * k + j];
+          q += diff * diff / (double)var[i * k + j];
+        }
+        lp[j] = log_norm[j] - 0.5 * q;
+        m = std::max(m, lp[j]);
+      }
+      double z = 0.0;
+      for (int64_t j = 0; j < k; ++j) {
+        gamma[j] = std::exp(lp[j] - m);
+        z += gamma[j];
+      }
+      for (int64_t j = 0; j < k; ++j) {
+        const double g = gamma[j] / z;
+        nk_l[j] += g;
+        for (int64_t i = 0; i < d; ++i) {
+          const double xi = xr[i];
+          sx_l[i * k + j] += g * xi;
+          sxx_l[i * k + j] += g * xi * xi;
+        }
+      }
+    }
+#pragma omp critical
+    {
+      for (int64_t j = 0; j < k; ++j) nk[j] += nk_l[j];
+      for (int64_t t = 0; t < d * k; ++t) {
+        sx[t] += sx_l[t];
+        sxx[t] += sxx_l[t];
+      }
+    }
+  }
+}
+
+ffi::Error GmmEmImpl(ffi::BufferR2<ffi::F32> x,      // (n, d)
+                     ffi::BufferR2<ffi::F32> mu0,    // (d, k)
+                     ffi::BufferR2<ffi::F32> var0,   // (d, k)
+                     ffi::BufferR1<ffi::F32> w0,     // (k,)
+                     ffi::ResultBufferR2<ffi::F32> mu_out,
+                     ffi::ResultBufferR2<ffi::F32> var_out,
+                     ffi::ResultBufferR1<ffi::F32> w_out,
+                     int64_t max_iter, float var_floor) {
+  const int64_t n = x.dimensions()[0];
+  const int64_t d = x.dimensions()[1];
+  const int64_t k = w0.dimensions()[0];
+  if (mu0.dimensions()[0] != d || mu0.dimensions()[1] != k) {
+    return ffi::Error::InvalidArgument("gmm_em: mu0 shape mismatch");
+  }
+
+  std::vector<float> mu(mu0.typed_data(), mu0.typed_data() + d * k);
+  std::vector<float> var(var0.typed_data(), var0.typed_data() + d * k);
+  std::vector<float> w(w0.typed_data(), w0.typed_data() + k);
+  std::vector<double> nk(k), sx(d * k), sxx(d * k);
+
+  for (int64_t it = 0; it < max_iter; ++it) {
+    em_pass(x.typed_data(), n, d, k, mu.data(), var.data(), w.data(),
+            nk.data(), sx.data(), sxx.data());
+    for (int64_t j = 0; j < k; ++j) {
+      const double denom = nk[j] + 1e-10;
+      for (int64_t i = 0; i < d; ++i) {
+        const double m = sx[i * k + j] / denom;
+        const double v = sxx[i * k + j] / denom - m * m;
+        mu[i * k + j] = (float)m;
+        var[i * k + j] = (float)std::max(v, (double)var_floor);
+      }
+      w[j] = (float)(nk[j] / (double)n);
+    }
+  }
+
+  std::copy(mu.begin(), mu.end(), mu_out->typed_data());
+  std::copy(var.begin(), var.end(), var_out->typed_data());
+  std::copy(w.begin(), w.end(), w_out->typed_data());
+  return ffi::Error::Success();
+}
+
+ffi::Error FisherImpl(ffi::BufferR3<ffi::F32> batch,  // (n, d, m)
+                      ffi::BufferR2<ffi::F32> mu,     // (d, k)
+                      ffi::BufferR2<ffi::F32> var,    // (d, k)
+                      ffi::BufferR1<ffi::F32> w,      // (k,)
+                      ffi::ResultBufferR3<ffi::F32> out) {  // (n, d, 2k)
+  const int64_t n = batch.dimensions()[0];
+  const int64_t d = batch.dimensions()[1];
+  const int64_t m = batch.dimensions()[2];
+  const int64_t k = w.dimensions()[0];
+  if (mu.dimensions()[0] != d || mu.dimensions()[1] != k ||
+      var.dimensions()[0] != d || var.dimensions()[1] != k) {
+    return ffi::Error::InvalidArgument(
+        "fisher: gmm parameter shapes do not match batch dim / weights");
+  }
+
+  const float* mu_p = mu.typed_data();
+  const float* var_p = var.typed_data();
+  const float* w_p = w.typed_data();
+
+  std::vector<double> log_norm(k);
+  for (int64_t j = 0; j < k; ++j) {
+    double s = 0.0;
+    for (int64_t i = 0; i < d; ++i) {
+      s += std::log(2.0 * M_PI * var_p[i * k + j]);
+    }
+    log_norm[j] = -0.5 * s + std::log(std::max((double)w_p[j], 1e-30));
+  }
+
+#pragma omp parallel for
+  for (int64_t img = 0; img < n; ++img) {
+    const float* xb = batch.typed_data() + img * d * m;  // (d, m) desc-major
+    float* ob = out->typed_data() + img * d * 2 * k;
+    std::vector<double> lp(k), gamma(k);
+    std::vector<double> s0(k, 0.0), s1(d * k, 0.0), s2(d * k, 0.0);
+    for (int64_t c = 0; c < m; ++c) {  // descriptor column c: xb[i*m + c]
+      double mx = -1e300;
+      for (int64_t j = 0; j < k; ++j) {
+        double q = 0.0;
+        for (int64_t i = 0; i < d; ++i) {
+          const double diff =
+              (double)xb[i * m + c] - (double)mu_p[i * k + j];
+          q += diff * diff / (double)var_p[i * k + j];
+        }
+        lp[j] = log_norm[j] - 0.5 * q;
+        mx = std::max(mx, lp[j]);
+      }
+      double z = 0.0;
+      for (int64_t j = 0; j < k; ++j) {
+        gamma[j] = std::exp(lp[j] - mx);
+        z += gamma[j];
+      }
+      for (int64_t j = 0; j < k; ++j) {
+        const double g = gamma[j] / z;
+        s0[j] += g;
+        for (int64_t i = 0; i < d; ++i) {
+          const double xi = xb[i * m + c];
+          s1[i * k + j] += g * xi;
+          s2[i * k + j] += g * xi * xi;
+        }
+      }
+    }
+    // improved FV, no internal normalization (enceval alpha=1, pnorm=0):
+    // mean gradient then variance gradient, (d, 2k) row-major
+    for (int64_t i = 0; i < d; ++i) {
+      for (int64_t j = 0; j < k; ++j) {
+        const double muij = mu_p[i * k + j];
+        const double sig = std::sqrt((double)var_p[i * k + j]);
+        const double fv_mu = (s1[i * k + j] - s0[j] * muij) / sig /
+                             ((double)m * std::sqrt((double)w_p[j]));
+        const double quad = s2[i * k + j] - 2.0 * s1[i * k + j] * muij +
+                            s0[j] * muij * muij;
+        const double fv_sig =
+            (quad / (sig * sig) - s0[j]) /
+            ((double)m * std::sqrt(2.0 * (double)w_p[j]));
+        ob[i * 2 * k + j] = (float)fv_mu;
+        ob[i * 2 * k + k + j] = (float)fv_sig;
+      }
+    }
+  }
+  return ffi::Error::Success();
+}
+
+}  // namespace
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    KeystoneGmmEm, GmmEmImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::BufferR2<ffi::F32>>()
+        .Arg<ffi::BufferR2<ffi::F32>>()
+        .Arg<ffi::BufferR2<ffi::F32>>()
+        .Arg<ffi::BufferR1<ffi::F32>>()
+        .Ret<ffi::BufferR2<ffi::F32>>()
+        .Ret<ffi::BufferR2<ffi::F32>>()
+        .Ret<ffi::BufferR1<ffi::F32>>()
+        .Attr<int64_t>("max_iter")
+        .Attr<float>("var_floor"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(KeystoneFisher, FisherImpl,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::BufferR3<ffi::F32>>()
+                                  .Arg<ffi::BufferR2<ffi::F32>>()
+                                  .Arg<ffi::BufferR2<ffi::F32>>()
+                                  .Arg<ffi::BufferR1<ffi::F32>>()
+                                  .Ret<ffi::BufferR3<ffi::F32>>());
