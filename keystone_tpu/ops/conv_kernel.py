@@ -1,0 +1,151 @@
+"""Pallas TPU kernel: fused im2col + patch-normalize + filter gemm.
+
+The :class:`keystone_tpu.ops.images.Convolver` (reference
+``nodes/images/Convolver.scala``) is not a plain convolution — each patch
+is mean/variance normalized and whitener-mean-subtracted before the filter
+gemm — so XLA materializes the full (N, oh, ow, k²C) patch tensor in HBM
+(~k² × the image bytes; 27x for 6x6 patches on CIFAR). This kernel keeps
+the whole im2col pipeline in VMEM per image: build the patch matrix in
+scratch with k² strided copies, normalize rows on the VPU, subtract the
+whitener means, and run one MXU gemm against the filter bank — HBM sees
+only the image in and the feature map out.
+
+Used automatically by ``Convolver`` on TPU for images that fit the VMEM
+budget; interpret mode covers the CPU test mesh. Layout contract matches
+``extract_patches``: patch rows flattened (dy, dx, c), channel fastest.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from keystone_tpu.ops.flash_attention import _pad_to, on_tpu
+
+_LANE = 128
+
+
+def _conv_kernel(
+    img_ref,  # (1, h, w, c)
+    filt_ref,  # (P_pad, F_pad) — transposed filter bank
+    mean_ref,  # (1, P_pad) whitener means (zeros when unused)
+    o_ref,  # (1, oh*ow padded, F_pad)
+    p_scr,  # (R_pad, P_pad) patch-matrix scratch
+    *,
+    patch_size: int,
+    oh: int,
+    ow: int,
+    c: int,
+    normalize: bool,
+    var_constant: float,
+    subtract_mean: bool,
+):
+    k = patch_size
+    rows = oh * ow
+    # im2col into scratch: one strided copy per (dy, dx) offset writes the
+    # (oh, ow, c) window slab into columns [(dy*k+dx)*c, +c)
+    img = img_ref[0]
+    for dy in range(k):
+        for dx in range(k):
+            slab = img[dy : dy + oh, dx : dx + ow, :]  # (oh, ow, c)
+            p_scr[:rows, (dy * k + dx) * c : (dy * k + dx + 1) * c] = (
+                slab.reshape(rows, c)
+            )
+
+    d = k * k * c  # true patch length; scratch columns beyond d hold
+    # garbage (never written) — mask them out of every statistic. The gemm
+    # itself is safe either way: the padded filter rows are zero.
+    p = p_scr[:rows, :]
+    col = jax.lax.broadcasted_iota(jnp.int32, p.shape, 1)
+    p = jnp.where(col < d, p, 0.0)
+    if normalize:
+        mean = jnp.sum(p, axis=1, keepdims=True) / d
+        centered = jnp.where(col < d, p - mean, 0.0)
+        var = jnp.sum(centered * centered, axis=1, keepdims=True) / max(
+            d - 1, 1
+        )
+        p = centered / jnp.sqrt(var + var_constant)
+    if subtract_mean:
+        p = jnp.where(col < d, p - mean_ref[0][None, :], 0.0)
+    o_ref[0, :rows, :] = jnp.dot(
+        p, filt_ref[:, :], preferred_element_type=jnp.float32
+    ).astype(o_ref.dtype)
+
+
+def fused_convolver(
+    batch,
+    filters,
+    *,
+    patch_size: int,
+    normalize_patches: bool,
+    var_constant: float,
+    whitener_means=None,
+    interpret: bool | None = None,
+):
+    """Fused Convolver forward. batch: (N, H, W, C); filters: (F, k²C).
+
+    Returns (N, oh, ow, F), identical to the im2col jnp path.
+    """
+    if interpret is None:
+        interpret = not on_tpu()
+    n, h, w, c = batch.shape
+    k = patch_size
+    oh, ow = h - k + 1, w - k + 1
+    rows, d = oh * ow, k * k * c
+    f = filters.shape[0]
+
+    ft = _pad_to(_pad_to(filters.T, 0, _LANE), 1, _LANE)  # (P_pad, F_pad)
+    p_pad, f_pad = ft.shape
+    rows_pad = -(-rows // 8) * 8
+    means = (
+        jnp.zeros((1, p_pad), jnp.float32)
+        if whitener_means is None
+        else _pad_to(
+            jnp.asarray(whitener_means, jnp.float32).reshape(1, d), 1, _LANE
+        )
+    )
+
+    out = pl.pallas_call(
+        functools.partial(
+            _conv_kernel,
+            patch_size=k,
+            oh=oh,
+            ow=ow,
+            c=c,
+            normalize=normalize_patches,
+            var_constant=var_constant,
+            subtract_mean=whitener_means is not None,
+        ),
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((1, h, w, c), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((p_pad, f_pad), lambda i: (0, 0)),
+            pl.BlockSpec((1, p_pad), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, rows_pad, f_pad), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, rows_pad, f_pad), batch.dtype),
+        scratch_shapes=[pltpu.VMEM((rows_pad, p_pad), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",),
+        ),
+        interpret=interpret,
+    )(batch.astype(jnp.float32), ft.astype(jnp.float32), means)
+    return out[:, :rows, :f].reshape(n, oh, ow, f)
+
+
+def fused_convolver_fits(h: int, w: int, c: int, patch_size: int,
+                         num_filters: int) -> bool:
+    """Whether the per-image working set fits the VMEM budget."""
+    k = patch_size
+    oh, ow = h - k + 1, w - k + 1
+    rows_pad = -(-(oh * ow) // 8) * 8
+    p_pad = -(-(k * k * c) // _LANE) * _LANE
+    f_pad = -(-num_filters // _LANE) * _LANE
+    bytes_needed = 4 * (
+        h * w * c + rows_pad * p_pad + p_pad * f_pad + rows_pad * f_pad
+    )
+    return bytes_needed <= 10 * 1024 * 1024

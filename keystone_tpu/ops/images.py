@@ -113,8 +113,33 @@ class Convolver(Transformer):
     patch_size: int = static_field(default=6)
     normalize_patches: bool = static_field(default=True)
     var_constant: float = static_field(default=10.0)
+    # "auto": fused Pallas im2col kernel on TPU when the per-image working
+    # set fits VMEM (keystone_tpu/ops/conv_kernel.py), XLA im2col otherwise
+    impl: str = static_field(default="auto")
 
     def __call__(self, batch):
+        if self.impl in ("auto", "fused"):
+            from keystone_tpu.ops import conv_kernel
+            from keystone_tpu.ops.flash_attention import on_tpu
+
+            n, h, w, c = batch.shape
+            fits = conv_kernel.fused_convolver_fits(
+                h, w, c, self.patch_size, self.filters.shape[0]
+            )
+            # auto only on a single chip: pallas_call is not GSPMD-auto-
+            # partitionable, so sharded multi-device batches keep the XLA
+            # im2col path (mesh users can call impl="fused" inside their
+            # own shard_map)
+            auto_ok = on_tpu() and fits and jax.device_count() == 1
+            if self.impl == "fused" or auto_ok:
+                return conv_kernel.fused_convolver(
+                    batch,
+                    self.filters,
+                    patch_size=self.patch_size,
+                    normalize_patches=self.normalize_patches,
+                    var_constant=self.var_constant,
+                    whitener_means=self.whitener_means,
+                )
         p = extract_patches(batch, self.patch_size)  # (N, oh, ow, k²C)
         if self.normalize_patches:
             p = normalize_patch_rows(p, self.var_constant)
