@@ -76,6 +76,17 @@ def _conv_kernel(
     ).astype(o_ref.dtype)
 
 
+def _padded_dims(h: int, w: int, c: int, patch_size: int, num_filters: int):
+    """Padded buffer dims shared by the kernel launch and the VMEM gate."""
+    k = patch_size
+    oh, ow = h - k + 1, w - k + 1
+    rows = oh * ow
+    rows_pad = -(-rows // 8) * 8
+    p_pad = -(-(k * k * c) // _LANE) * _LANE
+    f_pad = -(-num_filters // _LANE) * _LANE
+    return oh, ow, rows, rows_pad, p_pad, f_pad
+
+
 def fused_convolver(
     batch,
     filters,
@@ -94,13 +105,12 @@ def fused_convolver(
         interpret = not on_tpu()
     n, h, w, c = batch.shape
     k = patch_size
-    oh, ow = h - k + 1, w - k + 1
-    rows, d = oh * ow, k * k * c
     f = filters.shape[0]
+    oh, ow, rows, rows_pad, p_pad, f_pad = _padded_dims(h, w, c, k, f)
+    d = k * k * c
 
     ft = _pad_to(_pad_to(filters.T, 0, _LANE), 1, _LANE)  # (P_pad, F_pad)
-    p_pad, f_pad = ft.shape
-    rows_pad = -(-rows // 8) * 8
+    assert ft.shape == (p_pad, f_pad)
     means = (
         jnp.zeros((1, p_pad), jnp.float32)
         if whitener_means is None
@@ -140,11 +150,9 @@ def fused_convolver(
 def fused_convolver_fits(h: int, w: int, c: int, patch_size: int,
                          num_filters: int) -> bool:
     """Whether the per-image working set fits the VMEM budget."""
-    k = patch_size
-    oh, ow = h - k + 1, w - k + 1
-    rows_pad = -(-(oh * ow) // 8) * 8
-    p_pad = -(-(k * k * c) // _LANE) * _LANE
-    f_pad = -(-num_filters // _LANE) * _LANE
+    _, _, _, rows_pad, p_pad, f_pad = _padded_dims(
+        h, w, c, patch_size, num_filters
+    )
     bytes_needed = 4 * (
         h * w * c + rows_pad * p_pad + p_pad * f_pad + rows_pad * f_pad
     )

@@ -118,6 +118,10 @@ class Convolver(Transformer):
     impl: str = static_field(default="auto")
 
     def __call__(self, batch):
+        if self.impl not in ("auto", "fused", "xla"):
+            raise ValueError(
+                f"Convolver impl={self.impl!r}; expected auto|fused|xla"
+            )
         if self.impl in ("auto", "fused"):
             from keystone_tpu.ops import conv_kernel
             from keystone_tpu.ops.flash_attention import on_tpu
@@ -132,14 +136,19 @@ class Convolver(Transformer):
             # own shard_map)
             auto_ok = on_tpu() and fits and jax.device_count() == 1
             if self.impl == "fused" or auto_ok:
-                return conv_kernel.fused_convolver(
-                    batch,
-                    self.filters,
-                    patch_size=self.patch_size,
-                    normalize_patches=self.normalize_patches,
-                    var_constant=self.var_constant,
-                    whitener_means=self.whitener_means,
-                )
+                try:
+                    return conv_kernel.fused_convolver(
+                        batch,
+                        self.filters,
+                        patch_size=self.patch_size,
+                        normalize_patches=self.normalize_patches,
+                        var_constant=self.var_constant,
+                        whitener_means=self.whitener_means,
+                    )
+                except Exception:  # noqa: BLE001
+                    if self.impl == "fused":
+                        raise
+                    # auto: trace-time kernel failure falls back to XLA
         p = extract_patches(batch, self.patch_size)  # (N, oh, ow, k²C)
         if self.normalize_patches:
             p = normalize_patch_rows(p, self.var_constant)

@@ -1,0 +1,133 @@
+"""GPipe-style pipeline parallelism over a mesh axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2.11: stages run to
+completion; Spark's lazy evaluation is the only overlap). Here stage
+overlap is a first-class mechanism completing the DP/TP/PP/SP/EP matrix:
+a chain of equal-width stages is sharded one-stage-per-device along a mesh
+axis, and microbatches stream through the chain with activations handed to
+the next stage via ``ppermute`` over ICI. After the ``n_stages - 1``-step
+fill, every device computes every step — the classic GPipe schedule with
+bubble fraction ``(S-1)/(S-1+M)``.
+
+Design notes (TPU-first):
+- the schedule is a ``lax.scan`` of length ``M + S - 1`` inside one
+  ``shard_map`` — one compiled program, no per-step dispatch;
+- stage parameters are a stacked pytree (leading axis = stage) sharded
+  along the pipeline axis, so each device holds exactly its stage;
+- outputs are collected on the last stage and ``psum``-broadcast so the
+  caller sees a replicated result.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _pipeline_shard(params, x, *, stage_fn, axis_name: str, n_micro: int):
+    """Runs on one device = one stage. params: stage-local pytree (leading
+    stage axis already sliced to size 1); x: (n_micro, ...) microbatches
+    (replicated)."""
+    n_stages = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    params = jax.tree_util.tree_map(lambda p: p[0], params)
+    is_first = stage == 0
+    is_last = stage == n_stages - 1
+    perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+
+    # mark the zero-init carries as varying over the pipeline axis (jax 0.9
+    # tracks varying-manual-axes through scan and rejects mixed carries)
+    act0 = lax.pcast(jnp.zeros_like(x[0]), (axis_name,), to="varying")
+    outs0 = lax.pcast(jnp.zeros_like(x), (axis_name,), to="varying")
+
+    def step(carry, t):
+        act_in, outs = carry
+        # stage 0 injects microbatch t (clamped; masked below), others use
+        # the activation handed over by the previous stage
+        mb = lax.dynamic_index_in_dim(
+            x, jnp.clip(t, 0, n_micro - 1), keepdims=False
+        )
+        inp = jnp.where(is_first, mb, act_in)
+        y = stage_fn(params, inp)
+        # device `stage` holds a live value at step t iff stage <= t <
+        # stage + n_micro (its microbatch index is t - stage)
+        live = jnp.logical_and(t >= stage, t < stage + n_micro)
+        y = jnp.where(live, y, jnp.zeros_like(y))
+        out_idx = jnp.clip(t - stage, 0, n_micro - 1)
+        outs = jnp.where(
+            jnp.logical_and(is_last, live),
+            lax.dynamic_update_index_in_dim(outs, y, out_idx, axis=0),
+            outs,
+        )
+        act_next = lax.ppermute(y, axis_name, perm)
+        return (act_next, outs), None
+
+    (_, outs), _ = lax.scan(
+        step, (act0, outs0), jnp.arange(n_micro + n_stages - 1)
+    )
+    # outputs live on the last stage only; psum replicates them everywhere
+    return lax.psum(outs, axis_name)
+
+
+def gpipe(
+    stage_fn,
+    stacked_params,
+    x,
+    mesh: Mesh,
+    *,
+    axis: str = "model",
+    n_micro: int | None = None,
+):
+    """Apply a pipeline of stages to microbatched input.
+
+    ``stage_fn(params, act) -> act`` — one stage's computation; every
+    stage must preserve the activation shape (equal-width chain).
+    ``stacked_params`` — pytree whose leaves have leading axis
+    ``n_stages``; sharded one-stage-per-device along ``axis``.
+    ``x`` — (n_micro, B, ...) microbatches, or (N, ...) with ``n_micro``
+    given to split the batch evenly.
+
+    Returns the chain output with the microbatch structure of ``x``,
+    replicated across the mesh.
+    """
+    n_stages = mesh.shape[axis]
+    reshaped = False
+    if n_micro is not None and (x.ndim == 0 or x.shape[0] != n_micro):
+        n = x.shape[0]
+        if n % n_micro:
+            raise ValueError(f"batch {n} not divisible by n_micro={n_micro}")
+        x = x.reshape(n_micro, n // n_micro, *x.shape[1:])
+        reshaped = True
+    m = x.shape[0]
+
+    for path, leaf in jax.tree_util.tree_leaves_with_path(stacked_params):
+        if leaf.shape[0] != n_stages:
+            raise ValueError(
+                f"stacked param {jax.tree_util.keystr(path)} has "
+                f"{leaf.shape[0]} stages on its leading axis; pipeline "
+                f"axis {axis!r} has {n_stages} devices"
+            )
+
+    pspec = P(axis)
+    fn = jax.shard_map(
+        partial(
+            _pipeline_shard,
+            stage_fn=stage_fn,
+            axis_name=axis,
+            n_micro=m,
+        ),
+        mesh=mesh,
+        in_specs=(
+            jax.tree_util.tree_map(lambda _: pspec, stacked_params),
+            P(),
+        ),
+        out_specs=P(),
+    )
+    out = fn(stacked_params, x)
+    if reshaped:
+        out = out.reshape(-1, *out.shape[2:])
+    return out

@@ -1,0 +1,79 @@
+"""GPipe pipeline parallelism must equal sequential stage application."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu.parallel.mesh import create_mesh
+from keystone_tpu.parallel.pipeline_parallel import gpipe
+
+
+def _stage_fn(params, act):
+    w, b = params["w"], params["b"]
+    return jnp.tanh(act @ w + b)
+
+
+def _stacked_params(rng, n_stages, d):
+    return {
+        "w": jnp.asarray(
+            rng.normal(scale=0.5, size=(n_stages, d, d)).astype(np.float32)
+        ),
+        "b": jnp.asarray(
+            rng.normal(size=(n_stages, d)).astype(np.float32)
+        ),
+    }
+
+
+def _sequential(params, x):
+    for s in range(params["w"].shape[0]):
+        x = _stage_fn(
+            {"w": params["w"][s], "b": params["b"][s]}, x
+        )
+    return x
+
+
+@pytest.fixture
+def pp_mesh(devices):
+    return create_mesh(data=1, model=8)
+
+
+def test_gpipe_equals_sequential(pp_mesh, rng):
+    d, n_micro, bsz = 16, 4, 8
+    params = _stacked_params(rng, 8, d)
+    x = jnp.asarray(
+        rng.normal(size=(n_micro, bsz, d)).astype(np.float32)
+    )
+    out = gpipe(_stage_fn, params, x, pp_mesh, axis="model")
+    ref = jnp.stack([_sequential(params, x[i]) for i in range(n_micro)])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_gpipe_flat_batch_and_jit(pp_mesh, rng):
+    d = 8
+    params = _stacked_params(rng, 8, d)
+    x = jnp.asarray(rng.normal(size=(24, d)).astype(np.float32))
+    out = jax.jit(
+        lambda p, b: gpipe(_stage_fn, p, b, pp_mesh, axis="model", n_micro=4)
+    )(params, x)
+    ref = _sequential(params, x)
+    assert out.shape == x.shape
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_gpipe_more_microbatches_than_stages(pp_mesh, rng):
+    d, n_micro = 8, 13  # n_micro > n_stages and not a multiple
+    params = _stacked_params(rng, 8, d)
+    x = jnp.asarray(
+        rng.normal(size=(n_micro, 4, d)).astype(np.float32)
+    )
+    out = gpipe(_stage_fn, params, x, pp_mesh, axis="model")
+    ref = jnp.stack([_sequential(params, x[i]) for i in range(n_micro)])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_gpipe_validates_stage_count(pp_mesh, rng):
+    params = _stacked_params(rng, 3, 8)  # 3 stages on an 8-device axis
+    x = jnp.zeros((4, 2, 8), jnp.float32)
+    with pytest.raises(ValueError, match="stages"):
+        gpipe(_stage_fn, params, x, pp_mesh, axis="model")
