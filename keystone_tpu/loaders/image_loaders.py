@@ -57,12 +57,15 @@ def load_tar_images(
     target_size: int | None = 256,
     workers: int = 8,
     decode_batch: int = 512,
+    name_prefix: str | None = None,
 ) -> tuple[list[str], np.ndarray]:
     """All images from the given tar files → (names, (N, S, S, 3) array).
 
-    Decoding streams in ``decode_batch``-sized groups so raw compressed
-    bytes are dropped as soon as each group is decoded (peak host memory is
-    pixels + one group of bytes, not the whole corpus's bytes).
+    ``name_prefix`` drops entries outside a path prefix *before* decode
+    (the reference's ``VOCDataPath.namePrefix`` filter). Decoding streams
+    in ``decode_batch``-sized groups so raw compressed bytes are dropped as
+    soon as each group is decoded (peak host memory is pixels + one group
+    of bytes, not the whole corpus's bytes).
     """
 
     def try_decode(nd):
@@ -90,6 +93,10 @@ def load_tar_images(
 
         for p in paths:
             for item in _iter_tar_images(p):
+                if name_prefix is not None and not item[0].startswith(
+                    name_prefix
+                ):
+                    continue
                 batch.append(item)
                 if len(batch) >= decode_batch:
                     flush()
@@ -111,25 +118,43 @@ def _expand(path: str, suffix: str) -> list[str]:
 
 
 def load_voc(
-    tar_path: str, label_csv_path: str, *, target_size: int | None = 256
+    tar_path: str,
+    label_csv_path: str,
+    *,
+    target_size: int | None = 256,
+    name_prefix: str | None = None,
 ) -> LabeledImages:
     """VOC2007 tar(s) + multi-label CSV → images with per-image label lists
-    (reference VOCLoader: CSV rows ``filename,label_index`` 1-indexed).
+    (reference VOCLoader.scala:41-63).
+
+    Two CSV layouts are accepted: the VOC2007 annotation export the
+    reference parses — header row then
+    ``id,class,classname,traintesteval,filename`` with 1-indexed class and
+    quoted paths (columns 1 and 4, VOCLoader.scala:50-53) — and the
+    simplified ``filename,label_index`` (also 1-indexed). ``name_prefix``
+    keeps only tar entries under a path prefix (the reference's
+    ``VOCDataPath.namePrefix``, e.g. "VOCdevkit/VOC2007/JPEGImages/").
 
     ``labels`` is an (N, k) int array padded with −1 (ragged multi-labels),
     feeding ClassLabelIndicators' padded path.
     """
     label_map: dict[str, list[int]] = {}
     with open(label_csv_path) as f:
-        for line in f:
-            line = line.strip()
-            if not line or line.startswith("#"):
-                continue
-            parts = line.split(",")
-            fname, label = parts[0].strip(), int(parts[1]) - 1
-            label_map.setdefault(fname, []).append(label)
+        lines = [ln.strip() for ln in f if ln.strip()]
+    reference_format = bool(lines) and lines[0].replace('"', "").lower().startswith("id,")
+    for line in lines[1 if reference_format else 0 :]:
+        if line.startswith("#"):
+            continue
+        parts = [p.strip().strip('"') for p in line.split(",")]
+        if reference_format:
+            fname, label = os.path.basename(parts[4]), int(parts[1]) - 1
+        else:
+            fname, label = parts[0], int(parts[1]) - 1
+        label_map.setdefault(fname, []).append(label)
 
-    names, images = load_tar_images(_expand(tar_path, ".tar"), target_size)
+    names, images = load_tar_images(
+        _expand(tar_path, ".tar"), target_size, name_prefix=name_prefix
+    )
     labels_ragged = [
         sorted(set(label_map.get(os.path.basename(n), []))) for n in names
     ]

@@ -47,6 +47,10 @@ class VOCConfig:
     train_labels: str = arg(default="", help="train multi-label csv")
     test_location: str = arg(default="", help="test tar file/dir/glob")
     test_labels: str = arg(default="", help="test multi-label csv")
+    name_prefix: str = arg(
+        default="VOCdevkit/VOC2007/JPEGImages/",
+        help="tar entry prefix to load (reference VOCDataPath.namePrefix)",
+    )
     desc_dim: int = arg(default=80, help="PCA output dim")
     vocab_size: int = arg(default=256, help="GMM centroids")
     num_pca_samples: int = arg(default=1_000_000)
@@ -84,10 +88,16 @@ def _load(conf: VOCConfig, which: str) -> LabeledImages:
         )
     if which == "train":
         return load_voc(
-            conf.train_location, conf.train_labels, target_size=conf.image_size
+            conf.train_location,
+            conf.train_labels,
+            target_size=conf.image_size,
+            name_prefix=conf.name_prefix or None,
         )
     return load_voc(
-        conf.test_location, conf.test_labels, target_size=conf.image_size
+        conf.test_location,
+        conf.test_labels,
+        target_size=conf.image_size,
+        name_prefix=conf.name_prefix or None,
     )
 
 

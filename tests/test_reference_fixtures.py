@@ -1,0 +1,173 @@
+"""Golden-parity tests against the reference repo's own test fixtures.
+
+The reference ships real fixtures (solver matrices, iris, a VOC codebook
+GMM, image tars with label files) and asserts specific facts about them in
+its suites; these tests re-assert the same facts through this framework's
+components — direct evidence the rebuilt loaders/solvers/artifact formats
+are interchangeable with the reference's. Skipped wholesale when the
+reference checkout is not mounted.
+
+Fixture facts mirrored from: BlockWeightedLeastSquaresSuite.scala (zero
+gradient on aMat/bMat, shuffle invariance), VOCLoaderSuite.scala (10
+images, 000104 ∈ {14,19}, 13 labels / 9 distinct),
+ImageNetLoaderSuite.scala (5 images, all label 12, n15075141 prefix),
+LinearDiscriminantAnalysisSuite.scala (iris), the GMM CSV artifact format
+(GaussianMixtureModel.scala load).
+"""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+REF = "/root/reference/src/test/resources"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REF), reason="reference checkout not mounted"
+)
+
+
+def _csv(path):
+    return np.loadtxt(path, delimiter=",", ndmin=2).astype(np.float32)
+
+
+def test_weighted_bcd_zero_gradient_on_reference_matrices():
+    """Same data + hyperparameters as the reference's golden solver test:
+    ‖∇‖ ≈ 0 (tolerance 1e-2) at the fitted solution."""
+    from keystone_tpu.ops.weighted_linear import (
+        BlockWeightedLeastSquaresEstimator,
+    )
+    from tests.test_weighted_solver import _weighted_gradient
+
+    a = _csv(f"{REF}/aMat.csv")
+    b = _csv(f"{REF}/bMat.csv")
+    lam, mw = 0.1, 0.3
+    model = BlockWeightedLeastSquaresEstimator(
+        block_size=4, num_iter=10, lam=lam, mixture_weight=mw
+    ).fit(jnp.asarray(a), jnp.asarray(b))
+    x = np.concatenate([np.asarray(blk) for blk in model.xs], axis=0)
+    grad = _weighted_gradient(
+        a.astype(np.float64), b.astype(np.float64), x, np.asarray(model.b),
+        lam, mw,
+    )
+    assert np.linalg.norm(grad) < 1e-2
+
+
+def test_weighted_bcd_shuffle_invariance_on_reference_matrices():
+    """Reference: the fit must not depend on row order (its groupByClasses
+    shuffle protected this); aMatShuffled is the same data permuted."""
+    from keystone_tpu.ops.weighted_linear import (
+        BlockWeightedLeastSquaresEstimator,
+    )
+
+    est = BlockWeightedLeastSquaresEstimator(
+        block_size=4, num_iter=10, lam=0.1, mixture_weight=0.3
+    )
+    m1 = est.fit(
+        jnp.asarray(_csv(f"{REF}/aMat.csv")),
+        jnp.asarray(_csv(f"{REF}/bMat.csv")),
+    )
+    m2 = est.fit(
+        jnp.asarray(_csv(f"{REF}/aMatShuffled.csv")),
+        jnp.asarray(_csv(f"{REF}/bMatShuffled.csv")),
+    )
+    x1 = np.concatenate([np.asarray(b) for b in m1.xs], axis=0)
+    x2 = np.concatenate([np.asarray(b) for b in m2.xs], axis=0)
+    np.testing.assert_allclose(x1, x2, atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(m1.b), np.asarray(m2.b), atol=1e-4
+    )
+
+
+def test_lda_separates_iris_fixture():
+    """Reference LDA is validated on iris; projected to 2 dims, classes
+    must be separable by nearest class-centroid."""
+    from keystone_tpu.ops.linalg import LinearDiscriminantAnalysis
+
+    rows = []
+    labels = []
+    names = {"Iris-setosa": 0, "Iris-versicolor": 1, "Iris-virginica": 2}
+    with open(f"{REF}/iris.data") as f:
+        for line in f:
+            parts = line.strip().split(",")
+            if len(parts) == 5:
+                rows.append([float(v) for v in parts[:4]])
+                labels.append(names[parts[4]])
+    x = np.asarray(rows, np.float32)
+    y = np.asarray(labels, np.int32)
+
+    mapper = LinearDiscriminantAnalysis(num_dimensions=2).fit(
+        jnp.asarray(x), y
+    )
+    z = np.asarray(mapper(jnp.asarray(x)))
+    centroids = np.stack([z[y == c].mean(0) for c in range(3)])
+    pred = np.argmin(
+        np.linalg.norm(z[:, None] - centroids[None], axis=-1), axis=1
+    )
+    assert (pred == y).mean() > 0.93
+
+
+def test_gmm_loads_reference_codebook_artifacts():
+    """The VOC codebook (means/variances/priors CSVs) is a real artifact
+    produced by the reference toolchain — our artifact loader must read it
+    and the Fisher encoder must consume it directly."""
+    from keystone_tpu.ops.gmm import FisherVector, GaussianMixtureModel
+
+    cb = f"{REF}/images/voc_codebook"
+    gmm = GaussianMixtureModel.load_csv(
+        f"{cb}/means.csv", f"{cb}/variances.csv", f"{cb}/priors"
+    )
+    assert gmm.dim == 80 and gmm.k == 256
+    np.testing.assert_allclose(float(jnp.sum(gmm.weights)), 1.0, atol=1e-3)
+
+    rng = np.random.default_rng(0)
+    batch = jnp.asarray(rng.normal(size=(2, 80, 40)).astype(np.float32))
+    fv = FisherVector(gmm=gmm)(batch)
+    assert fv.shape == (2, 80, 512)
+    assert bool(jnp.isfinite(fv).all())
+
+
+def test_voc_loader_reference_tar_and_labels():
+    from keystone_tpu.loaders.image_loaders import load_voc
+
+    data = load_voc(
+        f"{REF}/images/voc/voctest.tar",
+        f"{REF}/images/voclabels.csv",
+        target_size=128,
+        name_prefix="VOCdevkit/VOC2007/JPEGImages/",
+    )
+    assert data.images.shape[0] == 10  # VOCLoaderSuite: 10 images
+    flat = data.labels[data.labels >= 0]
+    assert flat.size == 13  # 13 labels total
+    assert np.unique(flat).size == 9  # 9 distinct
+    # 000104.jpg carries labels {14, 19} — recover it by its label pair
+    rows_with_pair = [
+        set(r[r >= 0].tolist()) for r in data.labels
+    ]
+    assert {14, 19} in rows_with_pair
+
+
+def test_imagenet_loader_reference_tar_and_labels():
+    from keystone_tpu.loaders.image_loaders import load_imagenet
+
+    data = load_imagenet(
+        f"{REF}/images/imagenet/n15075141.tar",
+        f"{REF}/images/imagenet-test-labels",
+        target_size=128,
+    )
+    assert data.images.shape[0] == 5  # ImageNetLoaderSuite: 5 images
+    assert set(np.asarray(data.labels).tolist()) == {12}
+
+
+def test_jpeg_and_png_decode_fixtures():
+    """Real image decode incl. the reference's grayscale-triplication rule
+    (ImageConversions.scala: grayscale loads as 3 identical channels)."""
+    from keystone_tpu.loaders.image_loaders import decode_image
+
+    with open(f"{REF}/images/000012.jpg", "rb") as f:
+        jpg = decode_image(f.read(), None)
+    assert jpg.ndim == 3 and jpg.shape[2] == 3
+    with open(f"{REF}/images/gantrycrane.png", "rb") as f:
+        png = decode_image(f.read(), None)
+    assert png.ndim == 3 and png.shape[2] == 3
