@@ -66,10 +66,16 @@ def _ring_attention_shard(
     q_pos = idx * s_local + jnp.arange(s_local)  # global query positions
 
     if use_flash:
-        from keystone_tpu.ops.flash_attention import flash_attention_step
+        from keystone_tpu.ops.flash_attention import (
+            _LANE,
+            flash_attention_step,
+        )
 
-        m = jnp.full((b, h, s_local), -1e30, jnp.float32)
-        l = jnp.zeros((b, h, s_local), jnp.float32)
+        # m/l carried in the kernel's native (…, LANE) tile across hops —
+        # only column 0 is meaningful; avoids a 128x broadcast/slice of
+        # the softmax state in and out of HBM on every ring step
+        m = jnp.full((b, h, s_local, _LANE), -1e30, jnp.float32)
+        l = jnp.zeros((b, h, s_local, _LANE), jnp.float32)
         acc = jnp.zeros((b, h, s_local, d), jnp.float32)
         k_blk, v_blk = k, v
         for step in range(n):
@@ -84,12 +90,13 @@ def _ring_attention_shard(
                 q_offset=idx * s_local,
                 k_offset=owner * s_local,
                 causal=causal,
+                padded_state=True,
             )
             if step + 1 < n:
                 perm = [(j, (j + 1) % n) for j in range(n)]
                 k_blk = lax.ppermute(k_blk, axis_name, perm)
                 v_blk = lax.ppermute(v_blk, axis_name, perm)
-        out = acc / jnp.maximum(l, 1e-30)[..., None]
+        out = acc / jnp.maximum(l[..., :1], 1e-30)
         return out.astype(q.dtype)
 
     m = jnp.full((b, h, s_local, 1), -jnp.inf, q.dtype)

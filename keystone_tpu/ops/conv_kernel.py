@@ -137,7 +137,7 @@ def fused_convolver(
             pl.BlockSpec((1, p_pad), lambda i: (0, 0)),
         ],
         out_specs=pl.BlockSpec((1, rows_pad, f_pad), lambda i: (i, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((n, rows_pad, f_pad), batch.dtype),
+        out_shape=jax.ShapeDtypeStruct((n, rows_pad, f_pad), jnp.float32),
         scratch_shapes=[pltpu.VMEM((rows_pad, p_pad), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel",),

@@ -378,6 +378,7 @@ def flash_attention_step(
     causal: bool = False,
     block_q: int = 128,
     block_k: int = 128,
+    padded_state: bool = False,
     interpret: bool | None = None,
 ):
     """One fused online-softmax update: attend q over a single K/V block.
@@ -389,6 +390,11 @@ def flash_attention_step(
     traced values are fine (ring attention passes axis_index-derived
     offsets). Shards that don't tile evenly into blocks are zero-padded
     (padded K positions are masked; padded q rows are sliced away).
+
+    With ``padded_state`` the m/l state is carried as (B, H, S_q, LANE)
+    float32 — the kernel's native VMEM tile — so a multi-hop caller (ring
+    attention) avoids re-broadcasting lane-1 state to 128 lanes and
+    re-slicing it on every hop; only column 0 is meaningful.
     """
     if interpret is None:
         interpret = not _on_tpu()
@@ -407,20 +413,28 @@ def flash_attention_step(
     s_q_pad, d_pad = qf.shape[1], qf.shape[2]
     s_k_pad = kf.shape[1]
     # state rides as (BH, S_q, LANE)/(BH, S_q, d_pad) VMEM-tiled arrays
-    mf = _pad_to(
-        jnp.broadcast_to(
-            m.reshape(b * h, s_q, 1), (b * h, s_q, _LANE)
-        ).astype(jnp.float32),
-        1,
-        block_q,
-    )
-    lf = _pad_to(
-        jnp.broadcast_to(
-            l.reshape(b * h, s_q, 1), (b * h, s_q, _LANE)
-        ).astype(jnp.float32),
-        1,
-        block_q,
-    )
+    if padded_state:
+        mf = _pad_to(
+            m.reshape(b * h, s_q, _LANE).astype(jnp.float32), 1, block_q
+        )
+        lf = _pad_to(
+            l.reshape(b * h, s_q, _LANE).astype(jnp.float32), 1, block_q
+        )
+    else:
+        mf = _pad_to(
+            jnp.broadcast_to(
+                m.reshape(b * h, s_q, 1), (b * h, s_q, _LANE)
+            ).astype(jnp.float32),
+            1,
+            block_q,
+        )
+        lf = _pad_to(
+            jnp.broadcast_to(
+                l.reshape(b * h, s_q, 1), (b * h, s_q, _LANE)
+            ).astype(jnp.float32),
+            1,
+            block_q,
+        )
     accf = _pad_to(
         _pad_to(acc.reshape(b * h, s_q, d), 2, _LANE).astype(jnp.float32),
         1,
@@ -455,6 +469,12 @@ def flash_attention_step(
         ),
         interpret=interpret,
     )(scalars, qf, kf, vf, mf, lf, accf)
+    if padded_state:
+        return (
+            m2[:, :s_q, :].reshape(b, h, s_q, _LANE),
+            l2[:, :s_q, :].reshape(b, h, s_q, _LANE),
+            acc2[:, :s_q, :d].reshape(b, h, s_q, d),
+        )
     return (
         m2[:, :s_q, 0].reshape(b, h, s_q),
         l2[:, :s_q, 0].reshape(b, h, s_q),

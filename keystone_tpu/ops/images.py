@@ -8,6 +8,7 @@ layout-compatible across the whole stack.
 
 from __future__ import annotations
 
+import logging
 from typing import Callable
 
 import jax
@@ -122,6 +123,9 @@ class Convolver(Transformer):
             raise ValueError(
                 f"Convolver impl={self.impl!r}; expected auto|fused|xla"
             )
+        # both impls compute and emit float32 (the fused kernel always
+        # does); keeps auto-path output independent of which impl runs
+        batch = batch.astype(jnp.float32)
         if self.impl in ("auto", "fused"):
             from keystone_tpu.ops import conv_kernel
             from keystone_tpu.ops.flash_attention import on_tpu
@@ -145,10 +149,16 @@ class Convolver(Transformer):
                         var_constant=self.var_constant,
                         whitener_means=self.whitener_means,
                     )
-                except Exception:  # noqa: BLE001
+                except Exception as e:  # noqa: BLE001
                     if self.impl == "fused":
                         raise
                     # auto: trace-time kernel failure falls back to XLA
+                    logging.getLogger("keystone_tpu").warning(
+                        "fused Convolver kernel failed (%s: %s); "
+                        "falling back to XLA im2col",
+                        type(e).__name__,
+                        e,
+                    )
         p = extract_patches(batch, self.patch_size)  # (N, oh, ow, k²C)
         if self.normalize_patches:
             p = normalize_patch_rows(p, self.var_constant)

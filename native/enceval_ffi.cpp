@@ -107,6 +107,7 @@ ffi::Error GmmEmImpl(ffi::BufferR2<ffi::F32> x,      // (n, d)
     em_pass(x.typed_data(), n, d, k, mu.data(), var.data(), w.data(),
             nk.data(), sx.data(), sxx.data());
     for (int64_t j = 0; j < k; ++j) {
+      // regularized nk used for all three updates, matching the jnp path
       const double denom = nk[j] + 1e-10;
       for (int64_t i = 0; i < d; ++i) {
         const double m = sx[i * k + j] / denom;
@@ -114,7 +115,7 @@ ffi::Error GmmEmImpl(ffi::BufferR2<ffi::F32> x,      // (n, d)
         mu[i * k + j] = (float)m;
         var[i * k + j] = (float)std::max(v, (double)var_floor);
       }
-      w[j] = (float)(nk[j] / (double)n);
+      w[j] = (float)(denom / (double)n);
     }
   }
 
