@@ -10,9 +10,12 @@ scratch with k² strided copies, normalize rows on the VPU, subtract the
 whitener means, and run one MXU gemm against the filter bank — HBM sees
 only the image in and the feature map out.
 
-Used automatically by ``Convolver`` on TPU for images that fit the VMEM
-budget; interpret mode covers the CPU test mesh. Layout contract matches
-``extract_patches``: patch rows flattened (dy, dx, c), channel fastest.
+Selected explicitly via ``Convolver(impl="fused")``; the default
+``conv`` impl (:func:`keystone_tpu.ops.images.conv_convolver`) measured
+faster on real v5e, so this kernel is kept as the single-chip Pallas
+exemplar rather than the auto path. Interpret mode covers the CPU test
+mesh. Layout contract matches ``extract_patches``: patch rows flattened
+(dy, dx, c), channel fastest.
 """
 
 from __future__ import annotations
@@ -24,7 +27,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from keystone_tpu.ops.flash_attention import _pad_to, on_tpu
+from keystone_tpu.ops.flash_attention import (
+    _pad_to,
+    _vmem_limit_bytes,
+    on_tpu,
+)
 
 _LANE = 128
 
@@ -141,6 +148,7 @@ def fused_convolver(
         scratch_shapes=[pltpu.VMEM((rows_pad, p_pad), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel",),
+            vmem_limit_bytes=None if interpret else _vmem_limit_bytes(),
         ),
         interpret=interpret,
     )(batch.astype(jnp.float32), ft.astype(jnp.float32), means)
@@ -149,11 +157,18 @@ def fused_convolver(
 
 def fused_convolver_fits(h: int, w: int, c: int, patch_size: int,
                          num_filters: int) -> bool:
-    """Whether the per-image working set fits the VMEM budget."""
+    """Whether the per-image working set fits the VMEM budget.
+
+    Mosaic double-buffers every windowed input/output, so the image,
+    filter, and output buffers count twice; only the scratch patch
+    matrix is single-buffered. Gate against 2/3 of the scoped limit for
+    the same safety margin the flash kernels use."""
     _, _, _, rows_pad, p_pad, f_pad = _padded_dims(
         h, w, c, patch_size, num_filters
     )
     bytes_needed = 4 * (
-        h * w * c + rows_pad * p_pad + p_pad * f_pad + rows_pad * f_pad
+        2 * (h * w * c + p_pad * f_pad + rows_pad * f_pad)
+        + rows_pad * p_pad
     )
-    return bytes_needed <= 10 * 1024 * 1024
+    limit = _vmem_limit_bytes() or 16 * 1024 * 1024
+    return bytes_needed <= (2 * limit) // 3

@@ -8,7 +8,6 @@ layout-compatible across the whole stack.
 
 from __future__ import annotations
 
-import logging
 from typing import Callable
 
 import jax
@@ -50,19 +49,28 @@ def extract_patches(batch, patch_size: int, stride: int = 1):
 
     Returns (N, oh, ow, patch_size·patch_size·C) with (dy, dx, c) flattening,
     channel fastest — matching the reference patch layout.
+
+    Pure strided slicing — exact data movement, no arithmetic. (The
+    previous ``conv_general_dilated_patches`` formulation lowers to a
+    real convolution, which at XLA's default precision rounds the patch
+    VALUES through bf16 passes — ~0.2% error on pixels, measured on both
+    CPU and TPU backends.)
     """
     n, h, w, c = batch.shape
-    patches = jax.lax.conv_general_dilated_patches(
-        jnp.transpose(batch, (0, 3, 1, 2)),  # NCHW
-        filter_shape=(patch_size, patch_size),
-        window_strides=(stride, stride),
-        padding="VALID",
-    )  # (N, C*ph*pw, oh, ow), feature dim ordered (c, dy, dx)
-    oh, ow = patches.shape[2], patches.shape[3]
-    patches = patches.reshape(n, c, patch_size, patch_size, oh, ow)
-    # → (N, oh, ow, dy, dx, c): channel fastest in the flattened patch
-    patches = jnp.transpose(patches, (0, 4, 5, 2, 3, 1))
-    return patches.reshape(n, oh, ow, patch_size * patch_size * c)
+    k = patch_size
+    oh = (h - k) // stride + 1
+    ow = (w - k) // stride + 1
+    slabs = [
+        batch[
+            :,
+            dy : dy + (oh - 1) * stride + 1 : stride,
+            dx : dx + (ow - 1) * stride + 1 : stride,
+            :,
+        ]
+        for dy in range(k)
+        for dx in range(k)
+    ]  # k² slabs of (N, oh, ow, C), ordered (dy, dx) — channel fastest
+    return jnp.concatenate(slabs, axis=-1).reshape(n, oh, ow, k * k * c)
 
 
 @treenode
@@ -92,17 +100,90 @@ def normalize_patch_rows(mat, var_constant: float = 10.0):
     return (mat - mean) / jnp.sqrt(var + var_constant)
 
 
+def conv_convolver(
+    batch,
+    filters,
+    *,
+    patch_size: int,
+    normalize_patches: bool,
+    var_constant: float,
+    whitener_means=None,
+    precision=None,
+):
+    """Convolver forward as ONE dense convolution plus box-filter algebra.
+
+    The reference's per-patch normalization (``Stats.normalizeRows``) is
+    affine in the patch: with per-patch mean mu and sigma = sqrt(var+vc),
+
+        ((p - mu)/sigma - m) . F_f = (p.F_f - mu * sum(F_f)) / sigma - m.F_f
+
+    so the whole im2col pipeline factors into a plain MXU convolution
+    (``p.F_f``) plus per-patch scalars from two box-filter reductions —
+    no (N, oh, ow, k^2 C) patch tensor ever exists. HBM traffic drops from
+    ~k^2 x image bytes to image-in/featuremap-out; this is the TPU-first
+    design the fused Pallas kernel approximated, measured faster than
+    both it and the XLA im2col path on a real v5e (TPU_VALIDATION.json).
+
+    The box sums run through ``lax.reduce_window`` (exact f32 VPU adds),
+    not the MXU, so mu/sigma carry no bf16-pass rounding.
+    """
+    n, h, w, c = batch.shape
+    k = patch_size
+    f = filters.shape[0]
+    d = k * k * c
+    batch = batch.astype(jnp.float32)
+    filters = filters.astype(jnp.float32)
+    # (F, d) rows are (dy, dx, c) flattened, channel fastest -> HWIO
+    wts = jnp.transpose(filters.reshape(f, k, k, c), (1, 2, 3, 0))
+    dn = jax.lax.conv_dimension_numbers(
+        batch.shape, wts.shape, ("NHWC", "HWIO", "NHWC")
+    )
+    out = jax.lax.conv_general_dilated(
+        batch, wts, (1, 1), "VALID", dimension_numbers=dn,
+        precision=precision,
+    )  # (N, oh, ow, F)
+    if normalize_patches:
+        csum = jnp.sum(batch, axis=-1)  # (N, H, W)
+        csq = jnp.sum(batch * batch, axis=-1)
+        box = lambda x: jax.lax.reduce_window(  # noqa: E731
+            x, 0.0, jax.lax.add, (1, k, k), (1, 1, 1), "VALID"
+        )
+        s1 = box(csum)  # (N, oh, ow) patch sums
+        s2 = box(csq)
+        mu = s1 / d
+        # clamp: one-pass variance can round slightly negative for flat
+        # patches, which would NaN the sqrt at var_constant=0
+        var = jnp.maximum(s2 - s1 * mu, 0.0) / max(d - 1, 1)
+        sigma = jnp.sqrt(var + var_constant)
+        colsum = jnp.sum(filters, axis=1)  # (F,)
+        out = (out - mu[..., None] * colsum) / sigma[..., None]
+    if whitener_means is not None:
+        out = out - jnp.einsum(
+            "fd,d->f",
+            filters,
+            jnp.asarray(whitener_means, jnp.float32),
+            precision=precision,
+        )
+    return out
+
+
 @treenode
 class Convolver(Transformer):
-    """Filter-bank convolution by im2col (reference nodes/images/Convolver.scala).
+    """Filter-bank convolution (reference nodes/images/Convolver.scala).
 
     The reference packs every patch into a row, optionally normalizes each
     patch (``Stats.normalizeRows`` with ``varConstant``), optionally
     subtracts the whitener means, then does one gemm with the filter bank.
-    Per-patch normalization makes this NOT a plain convolution, so the
-    im2col design is kept: patches → normalize → subtract mean → MXU gemm.
-    Without normalization/whitening this lowers to the same FLOPs XLA would
-    emit for ``lax.conv``.
+    Implementations:
+
+    - ``conv`` (default via auto): :func:`conv_convolver` — the
+      normalization algebra folded around one dense MXU convolution.
+    - ``xla``: im2col — materialize patches, normalize, gemm (the
+      reference's schedule; the parity baseline the others are tested
+      against).
+    - ``fused``: Pallas im2col kernel (:mod:`keystone_tpu.ops.conv_kernel`)
+      keeping the patch matrix in VMEM; kept for single-chip use and as
+      the Pallas exemplar, but measured slower than ``conv`` on v5e.
 
     ``filters``: (num_filters, patch_size²·C), rows in (dy, dx, c) layout —
     exactly what :class:`Windower`+:class:`ImageVectorizer` sampling or
@@ -114,58 +195,50 @@ class Convolver(Transformer):
     patch_size: int = static_field(default=6)
     normalize_patches: bool = static_field(default=True)
     var_constant: float = static_field(default=10.0)
-    # "auto": fused Pallas im2col kernel on TPU when the per-image working
-    # set fits VMEM (keystone_tpu/ops/conv_kernel.py), XLA im2col otherwise
     impl: str = static_field(default="auto")
+    # gemm/conv precision: None = backend default (bf16 MXU passes on
+    # TPU, ~0.2% relative); "highest" = full f32 (reference-BLAS class)
+    precision: str | None = static_field(default=None)
 
     def __call__(self, batch):
-        if self.impl not in ("auto", "fused", "xla"):
+        if self.impl not in ("auto", "conv", "fused", "xla"):
             raise ValueError(
-                f"Convolver impl={self.impl!r}; expected auto|fused|xla"
+                f"Convolver impl={self.impl!r}; expected auto|conv|fused|xla"
             )
-        # both impls compute and emit float32 (the fused kernel always
-        # does); keeps auto-path output independent of which impl runs
+        # every impl computes and emits float32; keeps auto-path output
+        # independent of which impl runs
         batch = batch.astype(jnp.float32)
-        if self.impl in ("auto", "fused"):
-            from keystone_tpu.ops import conv_kernel
-            from keystone_tpu.ops.flash_attention import on_tpu
-
-            n, h, w, c = batch.shape
-            fits = conv_kernel.fused_convolver_fits(
-                h, w, c, self.patch_size, self.filters.shape[0]
+        if self.impl in ("auto", "conv"):
+            return conv_convolver(
+                batch,
+                self.filters,
+                patch_size=self.patch_size,
+                normalize_patches=self.normalize_patches,
+                var_constant=self.var_constant,
+                whitener_means=self.whitener_means,
+                precision=self.precision,
             )
-            # auto only on a single chip: pallas_call is not GSPMD-auto-
-            # partitionable, so sharded multi-device batches keep the XLA
-            # im2col path (mesh users can call impl="fused" inside their
-            # own shard_map)
-            auto_ok = on_tpu() and fits and jax.device_count() == 1
-            if self.impl == "fused" or auto_ok:
-                try:
-                    return conv_kernel.fused_convolver(
-                        batch,
-                        self.filters,
-                        patch_size=self.patch_size,
-                        normalize_patches=self.normalize_patches,
-                        var_constant=self.var_constant,
-                        whitener_means=self.whitener_means,
-                    )
-                except Exception as e:  # noqa: BLE001
-                    if self.impl == "fused":
-                        raise
-                    # auto: trace-time kernel failure falls back to XLA
-                    logging.getLogger("keystone_tpu").warning(
-                        "fused Convolver kernel failed (%s: %s); "
-                        "falling back to XLA im2col",
-                        type(e).__name__,
-                        e,
-                    )
+        if self.impl == "fused":
+            from keystone_tpu.ops import conv_kernel
+
+            return conv_kernel.fused_convolver(
+                batch,
+                self.filters,
+                patch_size=self.patch_size,
+                normalize_patches=self.normalize_patches,
+                var_constant=self.var_constant,
+                whitener_means=self.whitener_means,
+            )
         p = extract_patches(batch, self.patch_size)  # (N, oh, ow, k²C)
         if self.normalize_patches:
             p = normalize_patch_rows(p, self.var_constant)
         if self.whitener_means is not None:
             p = p - self.whitener_means
         return jnp.einsum(
-            "nhwp,fp->nhwf", p, self.filters.astype(p.dtype)
+            "nhwp,fp->nhwf",
+            p,
+            self.filters.astype(p.dtype),
+            precision=self.precision,
         )
 
 

@@ -43,6 +43,42 @@ def test_fused_matches_xla(rng, h, w, c, k, f, norm, whiten):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
 
 
+@pytest.mark.parametrize(
+    "h,w,c,k,f,norm,whiten",
+    [
+        (32, 32, 3, 6, 64, True, True),  # RandomPatchCifar shape
+        (32, 32, 3, 6, 64, True, False),
+        (28, 28, 1, 5, 32, False, False),  # plain convolution mode
+        (17, 19, 3, 4, 20, True, True),  # non-square, unaligned dims
+    ],
+)
+def test_conv_algebra_matches_xla(rng, h, w, c, k, f, norm, whiten):
+    """The default conv-algebra impl (one dense conv + box-filter
+    normalization) must match im2col at full precision."""
+    batch = jnp.asarray(rng.normal(size=(3, h, w, c)).astype(np.float32))
+    filters = jnp.asarray(
+        rng.normal(size=(f, k * k * c)).astype(np.float32)
+    )
+    wm = (
+        jnp.asarray(rng.normal(size=(k * k * c,)).astype(np.float32))
+        if whiten
+        else None
+    )
+    common = dict(
+        filters=filters,
+        whitener_means=wm,
+        patch_size=k,
+        normalize_patches=norm,
+        precision="highest",
+    )
+    ref = Convolver(impl="xla", **common)(batch)
+    out = Convolver(impl="conv", **common)(batch)
+    assert out.shape == (3, h - k + 1, w - k + 1, f)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-4
+    )
+
+
 def test_vmem_budget_gate():
     assert fused_convolver_fits(32, 32, 3, 6, 256)  # CIFAR-scale: fits
     assert not fused_convolver_fits(512, 512, 3, 12, 4096)  # too big

@@ -115,15 +115,15 @@ def test_flash_step_uneven_shard(rng):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
-def test_flash_streaming_variant(rng, monkeypatch):
+def test_flash_streaming_variant(rng):
     """Force the long-context K/V-streaming kernel and compare to dense."""
     import keystone_tpu.ops.flash_attention as fa
 
-    monkeypatch.setattr(fa, "_KV_VMEM_BUDGET", 1)
     q, k, v = _qkv(rng, b=1, h=2, s=256, d=64)
     for causal in (False, True):
         out = fa.flash_attention(
-            q, k, v, causal=causal, block_q=64, block_k=64
+            q, k, v, causal=causal, block_q=64, block_k=64,
+            kv_resident=False,
         )
         ref = dense_attention(q, k, v, causal=causal)
         np.testing.assert_allclose(

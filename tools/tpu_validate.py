@@ -1,0 +1,356 @@
+"""Validate the Pallas kernels COMPILED on real TPU hardware.
+
+Round-1 gap (VERDICT.md "What's weak" #2): both Pallas kernels had only
+ever run in interpret mode — Mosaic lowering failures and tile/VMEM
+mistakes would be invisible to the CPU-mesh test suite. This script runs
+on the real chip:
+
+- ``flash_attention`` in both variants (K/V-resident fori and the
+  streamed scratch-carry long-context path) compiled, vs the jnp dense
+  softmax reference;
+- ``flash_attention_step`` (the ring-attention inner kernel) chained over
+  hops, both lane-1 and padded state;
+- ``fused_convolver`` (im2col+normalize+gemm) vs the XLA im2col path;
+
+asserts numerical agreement and records compiled-vs-jnp timings in
+``TPU_VALIDATION.json`` at the repo root.
+
+Run: ``python tools/tpu_validate.py`` (exits nonzero off-TPU or on any
+numeric mismatch).
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+import time
+
+import numpy as np
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+
+def _sync(x):
+    # index on device BEFORE np.asarray — a full-array transfer through
+    # the axon tunnel costs seconds; a scalar read ~70ms
+    return float(np.asarray(jax.tree_util.tree_leaves(x)[0].ravel()[0]))
+
+
+def _time(fn, *args, iters: int = 10):
+    """Median-free amortized timing: dispatch ``iters`` async calls and
+    sync once, so the ~70ms tunnel round trip is paid once, not per
+    call. Returns seconds per call (includes per-dispatch overhead)."""
+    _sync(fn(*args))  # compile + warm
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(iters):
+        out = fn(*args)
+    _sync(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def _max_err(a, b):
+    return float(jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32))))
+
+
+def _np_attention_f64(q, k, v, *, causal: bool):
+    """Ground truth: dense softmax attention in numpy float64 on the host.
+
+    TPU f32 matmuls default to bf16-pass MXU arithmetic (~1e-3), so the
+    jnp dense path is not a precision reference; this is. Loops (b, h) to
+    bound the score-matrix footprint.
+    """
+    q = np.asarray(q, np.float64)
+    k = np.asarray(k, np.float64)
+    v = np.asarray(v, np.float64)
+    b, h, s_q, d = q.shape
+    s_k = k.shape[2]
+    out = np.empty((b, h, s_q, d), np.float64)
+    scale = 1.0 / np.sqrt(d)
+    mask = None
+    if causal:
+        mask = np.tril(np.ones((s_q, s_k), bool), k=s_k - s_q)
+    for bi in range(b):
+        for hi in range(h):
+            s = (q[bi, hi] @ k[bi, hi].T) * scale
+            if mask is not None:
+                s = np.where(mask, s, -np.inf)
+            s -= s.max(axis=-1, keepdims=True)
+            p = np.exp(s)
+            p /= p.sum(axis=-1, keepdims=True)
+            out[bi, hi] = p @ v[bi, hi]
+    return out.astype(np.float32)
+
+
+def validate_flash_attention(results):
+    from keystone_tpu.ops.attention import dense_attention
+    from keystone_tpu.ops.flash_attention import flash_attention
+
+    rng = np.random.default_rng(0)
+
+    # --- variant 1: K/V resident (fits the VMEM budget) ---
+    b, h, s, d = 4, 8, 1024, 64
+    q = jnp.asarray(rng.normal(size=(b, h, s, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, h, s, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, h, s, d)), jnp.float32)
+
+    for causal in (False, True):
+        truth = _np_attention_f64(q, k, v, causal=causal)
+        ref = jax.jit(lambda q, k, v: dense_attention(q, k, v, causal=causal))
+        fl = jax.jit(
+            lambda q, k, v: flash_attention(
+                q, k, v, causal=causal, kv_resident=True, interpret=False
+            )
+        )
+        err = _max_err(fl(q, k, v), truth)
+        err_jnp = _max_err(ref(q, k, v), truth)
+        t_ref, t_fl = _time(ref, q, k, v), _time(fl, q, k, v)
+        results[f"flash_fori_causal={causal}"] = {
+            "shape": [b, h, s, d],
+            "max_err_vs_f64": err,
+            "jnp_err_vs_f64": err_jnp,
+            "jnp_ms": round(t_ref * 1e3, 3),
+            "pallas_ms": round(t_fl * 1e3, 3),
+            "speedup": round(t_ref / t_fl, 2),
+        }
+        # MXU f32 default precision gives ~1e-3; require the kernel to be
+        # no worse than 4x the jnp dense path's own error
+        assert err < max(4 * err_jnp, 1e-4), (
+            f"flash fori causal={causal}: err {err} (jnp {err_jnp})"
+        )
+
+    # --- both variants at the shape that OOM'd scoped VMEM in round 1
+    # (K+V = 8MB; resident now rides the raised vmem limit, stream is
+    # forced to prove the long-context path) ---
+    b, h, s, d = 1, 2, 8192, 128
+    q = jnp.asarray(rng.normal(size=(b, h, s, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, h, s, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, h, s, d)), jnp.float32)
+    for causal in (False, True):
+        truth = _np_attention_f64(q, k, v, causal=causal)
+        ref = jax.jit(lambda q, k, v: dense_attention(q, k, v, causal=causal))
+        err_jnp = _max_err(ref(q, k, v), truth)
+        t_ref = _time(ref, q, k, v)
+        for name, resident in (("stream", False), ("resident8mb", True)):
+            fl = jax.jit(
+                lambda q, k, v: flash_attention(
+                    q,
+                    k,
+                    v,
+                    causal=causal,  # noqa: B023
+                    kv_resident=resident,  # noqa: B023
+                    interpret=False,
+                )
+            )
+            err = _max_err(fl(q, k, v), truth)
+            t_fl = _time(fl, q, k, v)
+            results[f"flash_{name}_causal={causal}"] = {
+                "shape": [b, h, s, d],
+                "max_err_vs_f64": err,
+                "jnp_err_vs_f64": err_jnp,
+                "jnp_ms": round(t_ref * 1e3, 3),
+                "pallas_ms": round(t_fl * 1e3, 3),
+                "speedup": round(t_ref / t_fl, 2),
+            }
+            assert err < max(4 * err_jnp, 1e-4), (
+                f"flash {name} causal={causal}: err {err} (jnp {err_jnp})"
+            )
+
+    # bf16 MXU path
+    b, h, s, d = 4, 8, 2048, 64
+    q = jnp.asarray(rng.normal(size=(b, h, s, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, h, s, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, h, s, d)), jnp.float32)
+    truth = _np_attention_f64(q, k, v, causal=False)
+    ref = jax.jit(lambda q, k, v: dense_attention(q, k, v))
+    fl16 = jax.jit(
+        lambda q, k, v: flash_attention(
+            q, k, v, mxu_dtype=jnp.bfloat16, interpret=False
+        )
+    )
+    err = _max_err(fl16(q, k, v), truth)
+    t_ref, t_fl = _time(ref, q, k, v), _time(fl16, q, k, v)
+    results["flash_bf16"] = {
+        "shape": [b, h, s, d],
+        "max_err_vs_f64": err,
+        "jnp_ms": round(t_ref * 1e3, 3),
+        "pallas_ms": round(t_fl * 1e3, 3),
+        "speedup": round(t_ref / t_fl, 2),
+    }
+    assert err < 5e-2, f"flash bf16: err {err}"
+
+
+def validate_flash_step(results):
+    """Chain flash_attention_step over hops == ring attention's inner loop."""
+    from keystone_tpu.ops.attention import dense_attention
+    from keystone_tpu.ops.flash_attention import _LANE, flash_attention_step
+
+    rng = np.random.default_rng(1)
+    b, h, s, d = 2, 4, 512, 64
+    hops = 4
+    q = jnp.asarray(rng.normal(size=(b, h, s, d)), jnp.float32)
+    ks = jnp.asarray(rng.normal(size=(hops, b, h, s, d)), jnp.float32)
+    vs = jnp.asarray(rng.normal(size=(hops, b, h, s, d)), jnp.float32)
+    k_full = jnp.concatenate(list(ks), axis=2)
+    v_full = jnp.concatenate(list(vs), axis=2)
+    ref = _np_attention_f64(q, k_full, v_full, causal=False)
+    err_jnp = _max_err(jax.jit(dense_attention)(q, k_full, v_full), ref)
+
+    for padded in (False, True):
+        state_shape = (b, h, s, _LANE) if padded else (b, h, s)
+
+        @jax.jit
+        def run(q, ks, vs):
+            m = jnp.full(state_shape, -1e30, jnp.float32)  # noqa: B023
+            l = jnp.zeros(state_shape, jnp.float32)  # noqa: B023
+            acc = jnp.zeros((b, h, s, d), jnp.float32)
+            for i in range(hops):
+                m, l, acc = flash_attention_step(
+                    q,
+                    ks[i],
+                    vs[i],
+                    m,
+                    l,
+                    acc,
+                    q_offset=0,
+                    k_offset=i * s,
+                    padded_state=padded,  # noqa: B023
+                    interpret=False,
+                )
+            lane = l[..., :1] if padded else l[..., None]  # noqa: B023
+            return acc / jnp.maximum(lane, 1e-30)
+
+        out = run(q, ks, vs)
+        err = _max_err(out, ref)
+        results[f"flash_step_padded={padded}"] = {
+            "shape": [b, h, s, d],
+            "hops": hops,
+            "max_err_vs_f64": err,
+            "jnp_err_vs_f64": err_jnp,
+        }
+        assert err < max(4 * err_jnp, 1e-4), (
+            f"flash step padded={padded}: err {err} (jnp {err_jnp})"
+        )
+
+
+def validate_fused_convolver(results):
+    from keystone_tpu.ops.conv_kernel import fused_convolver
+    from keystone_tpu.ops.images import extract_patches, normalize_patch_rows
+
+    rng = np.random.default_rng(2)
+    n, hh, ww, c, k, f = 256, 32, 32, 3, 6, 256  # CIFAR random-patch shape
+    batch = jnp.asarray(rng.normal(size=(n, hh, ww, c)), jnp.float32)
+    filters = jnp.asarray(rng.normal(size=(f, k * k * c)), jnp.float32)
+    means = jnp.asarray(rng.normal(size=(k * k * c,)), jnp.float32)
+
+    def xla_path(batch, filters, means):
+        patches = extract_patches(batch, k)  # (N, oh, ow, k²C)
+        oh, ow = patches.shape[1], patches.shape[2]
+        mat = patches.reshape(n * oh * ow, k * k * c)
+        mat = normalize_patch_rows(mat, 10.0) - means[None, :]
+        return (mat @ filters.T).reshape(n, oh, ow, f)
+
+    def np_truth():
+        bat = np.asarray(batch, np.float64)
+        d = k * k * c
+        # same patch layout as extract_patches: (dy, dx, c), c fastest
+        oh, ow = hh - k + 1, ww - k + 1
+        pat = np.empty((n, oh, ow, d), np.float64)
+        for dy in range(k):
+            for dx in range(k):
+                pat[..., (dy * k + dx) * c : (dy * k + dx + 1) * c] = bat[
+                    :, dy : dy + oh, dx : dx + ow, :
+                ]
+        mat = pat.reshape(-1, d)
+        mu = mat.mean(axis=1, keepdims=True)
+        cent = mat - mu
+        var = (cent * cent).sum(axis=1, keepdims=True) / (d - 1)
+        mat = cent / np.sqrt(var + 10.0) - np.asarray(means, np.float64)
+        out = mat @ np.asarray(filters, np.float64).T
+        return out.reshape(n, oh, ow, f).astype(np.float32)
+
+    from keystone_tpu.ops.images import conv_convolver
+
+    truth = np_truth()
+    ref = jax.jit(xla_path)
+    fused = jax.jit(
+        lambda b_, f_, m_: fused_convolver(
+            b_,
+            f_,
+            patch_size=k,
+            normalize_patches=True,
+            var_constant=10.0,
+            whitener_means=m_,
+            interpret=False,
+        )
+    )
+    conv = jax.jit(
+        lambda b_, f_, m_: conv_convolver(
+            b_,
+            f_,
+            patch_size=k,
+            normalize_patches=True,
+            var_constant=10.0,
+            whitener_means=m_,
+        )
+    )
+    err = _max_err(fused(batch, filters, means), truth)
+    err_jnp = _max_err(ref(batch, filters, means), truth)
+    err_conv = _max_err(conv(batch, filters, means), truth)
+    t_ref = _time(ref, batch, filters, means)
+    t_fused = _time(fused, batch, filters, means)
+    t_conv = _time(conv, batch, filters, means)
+    results["fused_convolver"] = {
+        "shape": [n, hh, ww, c],
+        "patch": k,
+        "filters": f,
+        "max_err_vs_f64": err,
+        "jnp_err_vs_f64": err_jnp,
+        "jnp_ms": round(t_ref * 1e3, 3),
+        "pallas_ms": round(t_fused * 1e3, 3),
+        "speedup": round(t_ref / t_fused, 2),
+    }
+    results["conv_convolver"] = {
+        "shape": [n, hh, ww, c],
+        "patch": k,
+        "filters": f,
+        "max_err_vs_f64": err_conv,
+        "im2col_ms": round(t_ref * 1e3, 3),
+        "conv_ms": round(t_conv * 1e3, 3),
+        "speedup_vs_im2col": round(t_ref / t_conv, 2),
+        "speedup_vs_pallas": round(t_fused / t_conv, 2),
+    }
+    assert err < max(4 * err_jnp, 1e-4), (
+        f"fused convolver: err {err} (jnp {err_jnp})"
+    )
+    assert err_conv < max(4 * err_jnp, 1e-4), (
+        f"conv convolver: err {err_conv} (jnp {err_jnp})"
+    )
+
+
+def main() -> int:
+    backend = jax.default_backend()
+    if backend not in ("tpu", "axon"):
+        print(f"not on TPU (backend={backend}); refusing to validate")
+        return 2
+    results: dict = {
+        "backend": backend,
+        "device": str(jax.devices()[0]),
+    }
+    validate_flash_attention(results)
+    validate_flash_step(results)
+    validate_fused_convolver(results)
+    out = REPO / "TPU_VALIDATION.json"
+    out.write_text(json.dumps(results, indent=2) + "\n")
+    print(json.dumps(results, indent=2))
+    print(f"\nall compiled-kernel validations passed -> {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
