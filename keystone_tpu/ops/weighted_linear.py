@@ -10,10 +10,14 @@ reference test's ``computeGradient`` defines exactly this objective).
 Reference mechanics → TPU mechanics:
 
 - one-class-per-Spark-partition + reshuffle detection
-  (``groupByClasses``, HashPartitioner(nClasses)) → *unnecessary*: per-class
-  statistics are masked segment reductions over the sharded batch, so rows
-  may live anywhere on the mesh. The shuffle disappears; the
-  permutation-invariance property it protected is tested directly.
+  (``groupByClasses``, HashPartitioner(nClasses)) → a one-time row
+  permutation into a class-sorted (C, L) grid inside the fit jit, after
+  which every per-class statistic is a reshape and per-class Grams are
+  batched gemms costing N·d² total — the same economics as the
+  reference's per-partition local Grams. Input rows may arrive in any
+  order (the permutation-invariance the shuffle protected is tested
+  directly); when labels are traced (fit under an outer jit) a masked
+  segment-reduction fallback covers correctness at C·N·d² cost.
 - per-partition ``(AᵀA, AᵀR)`` + mlmatrix treeReduce → sharded einsum
   contractions (XLA psum over ICI).
 - per-class local solves on executors, collected to the driver → batched
@@ -33,6 +37,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from keystone_tpu.core.pipeline import LabelEstimator
 from keystone_tpu.core.treenode import static_field, treenode
@@ -55,11 +60,40 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
     class_chunk: int = static_field(default=16)
 
     def fit(self, data, labels, n_valid: int | None = None) -> BlockLinearMapper:
-        blocks = _split_blocks(data, self.block_size)
+        # The sorted fast path needs concrete, host-fetchable labels:
+        # traced (fit under an outer jit) or multi-host non-addressable
+        # arrays take the masked-segment path — correct anywhere, at
+        # C·N·d² per-class-Gram cost.
+        concrete = not (
+            isinstance(data, jax.core.Tracer)
+            or isinstance(labels, jax.core.Tracer)
+        ) and getattr(labels, "is_fully_addressable", True)
+        sort_idx, class_l = None, None
+        if concrete:
+            # fast path: permute rows ONCE into a class-sorted (C, L) grid
+            # — the TPU analog of the reference's one-class-per-partition
+            # reshuffle (BlockWeightedLeastSquares.scala:324-361). Every
+            # per-class statistic then falls out of a reshape, and the
+            # per-class Grams are batched gemms costing N·d² total like
+            # the reference, not masked full-batch reductions (C·N·d²).
+            # The gather itself runs inside the jit (one dispatch); only
+            # the per-row argmax crosses to the host.
+            n_val = data.shape[0] if n_valid is None else int(n_valid)
+            class_idx = np.asarray(
+                jnp.argmax(jnp.asarray(labels)[:n_val], axis=-1)
+            )
+            perm = _class_sorted_perm(
+                class_idx, labels.shape[-1], data.shape[0]
+            )
+            if perm is not None:  # None: too imbalanced, grid would blow up
+                sort_idx, class_l = perm.reshape(-1), perm.shape[1]
         xs, b = _weighted_bcd_fit(
-            tuple(blocks),
+            data,
             labels,
+            sort_idx,
             n_valid,
+            class_l,
+            self.block_size,
             self.num_iter,
             self.lam,
             self.mixture_weight,
@@ -70,27 +104,84 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         )
 
 
+def _class_sorted_perm(
+    class_idx: np.ndarray, c: int, n_rows: int
+) -> np.ndarray | None:
+    """(C, L) row-index grid: row c lists the batch rows of class c, padded
+    with the sentinel ``n_rows`` (gathers hit an appended zero row).
+
+    L is the max class count rounded up to 64 rows to bound retrace churn
+    across fits of slightly different class balance. Returns None when the
+    padded grid would exceed ~2x the batch (heavy class imbalance: L is
+    sized to the LARGEST class, so a dominant class would inflate every
+    gathered copy toward C·L ≫ N) — callers then use the masked path.
+    """
+    counts = np.bincount(class_idx, minlength=c)
+    l_pad = max(-(-int(counts.max()) // 64) * 64, 64) if len(class_idx) else 64
+    if c * l_pad > 2 * n_rows + 64 * c:
+        return None
+    perm = np.full((c, l_pad), n_rows, np.int64)
+    order = np.argsort(class_idx, kind="stable")
+    offsets = np.zeros(c + 1, np.int64)
+    np.cumsum(counts, out=offsets[1:])
+    for ci in range(c):
+        seg = order[offsets[ci] : offsets[ci + 1]]
+        perm[ci, : len(seg)] = seg
+    return perm
+
+
 @partial(
-    jax.jit, static_argnames=("num_iter", "lam", "mixture_weight", "class_chunk")
+    jax.jit,
+    static_argnames=(
+        "class_l",
+        "block_size",
+        "num_iter",
+        "lam",
+        "mixture_weight",
+        "class_chunk",
+    ),
 )
 def _weighted_bcd_fit(
-    blocks: tuple,
+    data,
     labels,
+    sort_idx,
     n_valid,
+    class_l: int | None,
+    block_size: int,
     num_iter: int,
     lam: float,
     mixture_weight: float,
     class_chunk: int,
 ):
+    """Weighted BCD body. ``class_l`` non-None means ``sort_idx`` lays the
+    rows out as a class-sorted (C, class_l) grid (grid row r belongs to
+    class r // class_l; sentinel indices point at an appended zero row),
+    so per-class reductions are reshapes and per-class Grams are batched
+    gemms; None falls back to one-hot masked reductions over the batch."""
     w = mixture_weight
-    dtype = blocks[0].dtype
-    n_rows = blocks[0].shape[0]
+    dtype = data.dtype
     c = labels.shape[-1]
-    mask = _row_mask(n_rows, n_valid, dtype)  # (N, 1)
+    if class_l is not None:
+        n_orig = data.shape[0]
+        sort_idx = jnp.asarray(sort_idx)
+        data = jnp.concatenate(
+            [data, jnp.zeros((1, data.shape[-1]), dtype)]
+        )[sort_idx]
+        labels = jnp.concatenate(
+            [labels.astype(dtype), jnp.zeros((1, c), dtype)]
+        )[sort_idx]
+        mask = (sort_idx < n_orig)[:, None].astype(dtype)
+    else:
+        mask = _row_mask(data.shape[0], n_valid, dtype)
+    blocks = tuple(_split_blocks(data, block_size))
+    n_rows = blocks[0].shape[0]
     n = jnp.sum(mask)
 
     # one-hot class membership (argmax of ±1 indicators), padded rows zeroed
-    class_idx = jnp.argmax(labels, axis=-1)
+    if class_l is not None:
+        class_idx = jnp.arange(n_rows) // class_l  # layout-defined
+    else:
+        class_idx = jnp.argmax(labels, axis=-1)
     onehot = jax.nn.one_hot(class_idx, c, dtype=dtype) * mask  # (N, C)
     n_c = jnp.sum(onehot, axis=0)  # (C,)
     n_c_safe = jnp.maximum(n_c, 1.0)
@@ -113,6 +204,12 @@ def _weighted_bcd_fit(
 
     res_mean = residual_mean(resid)
 
+    def class_sum(x):
+        """Per-class column sums of a row-major (N, ...) array → (C, ...)."""
+        if class_l is not None:
+            return x.reshape(c, class_l, *x.shape[1:]).sum(axis=1)
+        return jnp.einsum("nc,n...->c...", onehot, x)
+
     # pass-0 cached per-block statistics (reference BlockStatistics)
     pop_means, pop_covs, joint_means = [], [], []
     for a in blocks:
@@ -120,7 +217,7 @@ def _weighted_bcd_fit(
         pop_mean = jnp.sum(a_m, axis=0) / n
         gram = a_m.T @ a_m  # sharded contraction → psum
         pop_cov = gram / n - jnp.outer(pop_mean, pop_mean)
-        class_mean = (onehot.T @ a_m) / n_c_safe[:, None]  # (C, d)
+        class_mean = class_sum(a_m) / n_c_safe[:, None]  # (C, d)
         joint_mean = w * class_mean + (1 - w) * pop_mean  # (C, d)
         pop_means.append(pop_mean)
         pop_covs.append(pop_cov)
@@ -141,21 +238,16 @@ def _weighted_bcd_fit(
             a_m = a * mask
             pop_mean, pop_cov, joint_mean = pop_means[i], pop_covs[i], joint_means[i]
             pop_xtr = (a_m.T @ resid) / n  # (d, C)
-            class_mean = (onehot.T @ a_m) / n_c_safe[:, None]  # (C, d)
+            class_mean = class_sum(a_m) / n_c_safe[:, None]  # (C, d)
             # per-class residual stats restricted to own-class rows/column
             r_own = jnp.sum(resid * onehot, axis=-1, keepdims=True)  # (N, 1)
-            class_xtr = (a_m.T @ (onehot * r_own)).T / n_c_safe[:, None]  # (C, d)
-            r_own_mean = jnp.sum(onehot * r_own, axis=0) / n_c_safe  # (C,)
+            class_xtr = class_sum(a_m * r_own) / n_c_safe[:, None]  # (C, d)
+            r_own_mean = class_sum(r_own)[:, 0] / n_c_safe  # (C,)
 
             mean_mix = (1 - w) * res_mean + w * r_own_mean  # (C,)
             model = xs[i]
 
             # chunked per-class covariance + solve
-            oh_chunks = pad_classes(onehot, 1).reshape(
-                n_rows, n_chunks, class_chunk
-            )
-            oh_chunks = jnp.moveaxis(oh_chunks, 1, 0)  # (K, N, S)
-
             stats = {
                 "class_mean": pad_classes(class_mean, 0).reshape(
                     n_chunks, class_chunk, -1
@@ -176,12 +268,26 @@ def _weighted_bcd_fit(
                     n_chunks, class_chunk, -1
                 ),
                 "n_c": pad_classes(n_c_safe, 0).reshape(n_chunks, class_chunk),
-                "onehot": oh_chunks,
             }
+            if class_l is not None:
+                # class-sorted rows: the chunk's own rows as (S, L, d) —
+                # per-class Grams are batched gemms over L rows each
+                stats["a_rows"] = pad_classes(
+                    a_m.reshape(c, class_l, -1), 0
+                ).reshape(n_chunks, class_chunk, class_l, -1)
+            else:
+                oh_chunks = pad_classes(onehot, 1).reshape(
+                    n_rows, n_chunks, class_chunk
+                )
+                stats["onehot"] = jnp.moveaxis(oh_chunks, 1, 0)  # (K, N, S)
 
             def solve_chunk(s, a_m=a_m, pop_cov=pop_cov, pop_mean=pop_mean):
-                # uncentered per-class Gram for the chunk: (S, d, d)
-                g = jnp.einsum("nd,ns,ne->sde", a_m, s["onehot"], a_m)
+                if class_l is not None:
+                    # (S, L, d) → (S, d, d): N·d² total across chunks
+                    g = jnp.einsum("sld,sle->sde", s["a_rows"], s["a_rows"])
+                else:
+                    # masked full-batch reduction: C·N·d² (traced-label path)
+                    g = jnp.einsum("nd,ns,ne->sde", a_m, s["onehot"], a_m)
                 mu = s["class_mean"]  # (S, d)
                 class_cov = g / s["n_c"][:, None, None] - jnp.einsum(
                     "sd,se->sde", mu, mu

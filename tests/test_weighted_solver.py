@@ -79,6 +79,34 @@ def test_weighted_sharded_padded_matches_local(rng, mesh8):
     )
 
 
+def test_sorted_layout_matches_masked_path(rng):
+    """The class-sorted grid layout (concrete labels, N·d² Grams) must
+    produce the same model as the masked-segment fallback (traced labels,
+    C·N·d² Grams) — same math, different data layout."""
+    import jax
+
+    from keystone_tpu.ops.weighted_linear import _weighted_bcd_fit
+
+    a, y = _data(rng, n=77, d=9, c=5)
+    est = BlockWeightedLeastSquaresEstimator(
+        block_size=5, num_iter=4, lam=0.1, mixture_weight=0.4, class_chunk=2
+    )
+    m_sorted = est.fit(jnp.asarray(a), jnp.asarray(y))  # concrete → sorted
+
+    # traced labels force the masked fallback
+    def fit_masked(a_, y_):
+        return _weighted_bcd_fit(
+            a_, y_, None, None, None, 5, 4, 0.1, 0.4, 2
+        )
+
+    xs, b = jax.jit(fit_masked)(jnp.asarray(a), jnp.asarray(y))
+    for x1, x2 in zip(m_sorted.xs, xs):
+        np.testing.assert_allclose(
+            np.asarray(x1), np.asarray(x2), atol=1e-4
+        )
+    np.testing.assert_allclose(np.asarray(m_sorted.b), np.asarray(b), atol=1e-4)
+
+
 def test_weighted_predictions_favor_upweighted_class(rng):
     """Higher mixture weight should raise recall of the positive class."""
     # imbalanced: class 0 rare
