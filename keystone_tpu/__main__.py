@@ -23,6 +23,10 @@ PIPELINES = {
         "keystone_tpu.models.cifar_random_patch",
         "pipelines.images.cifar.RandomPatchCifar",
     ),
+    "cifar-random": (
+        "keystone_tpu.models.cifar_random",
+        "pipelines.images.cifar.RandomCifar",
+    ),
     "voc-sift-fisher": (
         "keystone_tpu.models.voc_sift_fisher",
         "pipelines.images.voc.VOCSIFTFisher",

@@ -1,0 +1,173 @@
+"""CIFAR-10 random-filter convolution pipeline
+(reference ``pipelines/images/cifar/RandomCifar.scala:16-60``).
+
+The simplest conv CIFAR app: a RANDOM gaussian filter bank (no patch
+sampling, no ZCA) convolved with patch normalization, then
+SymmetricRectifier → sum Pooler → vectorize → StandardScaler (with std
+division) → exact ridge ``LinearMapEstimator`` (not block BCD) → argmax →
+multiclass eval. Distinct from ``cifar_random_patch`` (RandomPatchCifar),
+which whitens sampled patches and solves with block least squares.
+
+TPU shape: featurization is the conv-algebra Convolver in one jitted
+chunked program; the exact solve is sharded normal equations + replicated
+Cholesky.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import jax
+import numpy as np
+
+from keystone_tpu.core.batching import apply_in_chunks
+from keystone_tpu.core.config import arg, parse_config
+from keystone_tpu.core.logging import get_logger
+from keystone_tpu.evaluation import MulticlassClassifierEvaluator
+from keystone_tpu.models.cifar_linear_pixels import _load as _load_cifar_or_synth
+from keystone_tpu.ops.images import (
+    Convolver,
+    ImageVectorizer,
+    Pooler,
+    SymmetricRectifier,
+)
+from keystone_tpu.ops.linear import LinearMapEstimator
+from keystone_tpu.ops.stats import StandardScaler
+from keystone_tpu.ops.util import ClassLabelIndicators, MaxClassifier
+from keystone_tpu.parallel.mesh import create_mesh, shard_batch
+
+logger = get_logger("keystone_tpu.models.cifar_random")
+
+NUM_CLASSES = 10
+
+
+@dataclasses.dataclass
+class RandomCifarFilterConfig:
+    """Random-filter CIFAR workload (reference RandomCifarConfig,
+    RandomCifar.scala:72-81)."""
+
+    train_location: str = arg(default="", help="CIFAR-10 binary file/dir")
+    test_location: str = arg(default="", help="CIFAR-10 binary file/dir")
+    num_filters: int = arg(default=100)
+    patch_size: int = arg(default=6)
+    pool_size: int = arg(default=14)
+    pool_stride: int = arg(default=13)
+    alpha: float = arg(default=0.25, help="rectifier offset")
+    lam: float = arg(default=0.0, help="L2 regularization (0 = OLS)")
+    chunk_size: int = arg(default=1024, help="featurization chunk (images)")
+    sample_frac: float = arg(default=0.0, help="if > 0, subsample train")
+    seed: int = arg(default=0)
+    synthetic: int = arg(default=0, help="if > 0, N synthetic samples")
+
+
+def run(conf: RandomCifarFilterConfig, mesh=None) -> dict:
+    if mesh is None and len(jax.devices()) > 1:
+        mesh = create_mesh()
+    t0 = time.perf_counter()
+    train = _load_cifar_or_synth(_as_lp_conf(conf), "train")
+    test = _load_cifar_or_synth(_as_lp_conf(conf), "test")
+
+    rng = np.random.default_rng(conf.seed)
+    if conf.sample_frac > 0.0:
+        keep = rng.random(len(train)) < conf.sample_frac
+        train = dataclasses.replace(
+            train, images=train.images[keep], labels=train.labels[keep]
+        )
+
+    # random gaussian filter bank — RandomCifar.scala:37
+    filters = rng.normal(
+        size=(conf.num_filters, conf.patch_size**2 * 3)
+    ).astype(np.float32)
+
+    featurizer = (
+        Convolver(
+            filters=filters,
+            whitener_means=None,
+            patch_size=conf.patch_size,
+            normalize_patches=True,
+        )
+        >> SymmetricRectifier(alpha=conf.alpha)
+        >> Pooler(stride=conf.pool_stride, pool_size=conf.pool_size)
+        >> ImageVectorizer()
+    )
+    feat_fn = jax.jit(lambda b, p=featurizer: p(b))
+    t_setup = time.perf_counter()
+
+    def featurize(images: np.ndarray):
+        x = shard_batch(images, mesh)
+        return apply_in_chunks(feat_fn, x, conf.chunk_size)
+
+    f_train_raw = featurize(train.images)
+    # reference StandardScaler() divides by std (normalizeStdDev default
+    # true) — unlike RandomPatchCifar's center-only scaling
+    scaler = StandardScaler(normalize_std_dev=True).fit(
+        f_train_raw, n_valid=len(train)
+    )
+    f_train = scaler(f_train_raw)
+
+    y = np.zeros(f_train.shape[0], np.int32)
+    y[: len(train)] = train.labels
+    indicators = ClassLabelIndicators(num_classes=NUM_CLASSES)(y)
+    t_feat = time.perf_counter()
+
+    model = jax.block_until_ready(
+        LinearMapEstimator(lam=conf.lam).fit(
+            f_train, indicators, n_valid=len(train)
+        )
+    )
+    t_fit = time.perf_counter()
+
+    classify = MaxClassifier()
+    evaluator = MulticlassClassifierEvaluator(NUM_CLASSES)
+    train_eval = evaluator(classify(model(f_train)), y, n_valid=len(train))
+
+    f_test = scaler(featurize(test.images))
+    y_test = np.zeros(f_test.shape[0], np.int32)
+    y_test[: len(test)] = test.labels
+    test_eval = evaluator(
+        classify(model(f_test)), y_test, n_valid=len(test)
+    )
+    t_end = time.perf_counter()
+
+    result = {
+        "train_error": train_eval.error,
+        "test_error": test_eval.error,
+        "n_train": len(train),
+        "n_test": len(test),
+        "setup_s": t_setup - t0,
+        "featurize_s": t_feat - t_setup,
+        "fit_s": t_fit - t_feat,
+        "total_s": t_end - t0,
+        "featurize_fit_samples_per_s": len(train) / (t_fit - t_setup),
+    }
+    logger.info(
+        "RandomCifar: train err %.4f, test err %.4f, %.0f samples/s",
+        train_eval.error,
+        test_eval.error,
+        result["featurize_fit_samples_per_s"],
+    )
+    return result
+
+
+def _as_lp_conf(conf: RandomCifarFilterConfig):
+    from keystone_tpu.models.cifar_linear_pixels import LinearPixelsConfig
+
+    return LinearPixelsConfig(
+        train_location=conf.train_location,
+        test_location=conf.test_location,
+        synthetic=conf.synthetic,
+    )
+
+
+def main(argv=None) -> dict:
+    conf = parse_config(RandomCifarFilterConfig, argv)
+    if not conf.synthetic and not (conf.train_location and conf.test_location):
+        raise SystemExit(
+            "need --train-location AND --test-location, or --synthetic N"
+        )
+    return run(conf)
+
+
+if __name__ == "__main__":
+    main()

@@ -66,6 +66,31 @@ def test_random_patch_cifar_synthetic():
     assert res["n_train"] == 150
 
 
+def test_random_cifar_synthetic(mesh8):
+    """RandomCifar (reference RandomCifar.scala): random gaussian filter
+    bank + exact LinearMapEstimator, no whitening."""
+    from keystone_tpu.models import cifar_random as rc
+
+    conf = rc.RandomCifarFilterConfig(
+        synthetic=150,
+        num_filters=16,
+        lam=10.0,
+        chunk_size=64,
+    )
+    res = rc.run(conf, mesh=mesh8)
+    assert res["train_error"] < 0.1
+    assert res["test_error"] < 0.5
+    assert res["n_train"] == 150
+
+
+def test_random_cifar_cli_registered():
+    from keystone_tpu.__main__ import PIPELINES
+
+    assert "cifar-random" in PIPELINES
+    mod, ref = PIPELINES["cifar-random"]
+    assert ref == "pipelines.images.cifar.RandomCifar"
+
+
 def test_random_patch_cifar_mesh_matches_local(mesh8):
     conf = rp.RandomCifarConfig(
         synthetic=160,
