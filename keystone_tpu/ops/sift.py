@@ -1,28 +1,45 @@
-"""Dense multi-scale SIFT on-device.
+"""Dense multi-scale SIFT on-device — vl_dsift flat-window semantics.
 
 TPU-native replacement for the reference's native VLFeat JNI component
-(``src/main/cpp/VLFeat.cxx`` over vl_dsift; SURVEY.md §2.10). Shim-parity
-structure:
+(``src/main/cpp/VLFeat.cxx`` over vl_dsift; SURVEY.md §2.10). The shim
+runs vl_dsift with the FLAT-window fast path (``useFlatWindow=VL_TRUE``,
+``windowSize=1.5``, ``VLFeat.cxx:98-104``); this module reproduces that
+algorithm exactly, stage by stage:
 
-- scales: bin sizes ``bin + 2·s`` for s = 0..num_scales−1,
-- per scale the image is gaussian-smoothed with ``sigma = bin_s / 6``
-  (magnif 6), gradients → 8 soft-binned orientation planes, 4×4 spatial
-  bins of size ``bin_s``,
-- keypoint grid starts at ``off = (1 + 2·num_scales) − 3·s`` with the given
-  step (the shim's bounding-box trick),
-- descriptors L2-normalized, clamped at 0.2, renormalized (standard SIFT),
-- low-contrast descriptors (pre-normalization norm < 0.005) zeroed — the
-  shim's contrast-threshold zeroing,
-- quantized ``min(512·v, 255)`` like the shim's short output.
+- scales: bin sizes ``bin + 2·s``; per scale the ORIGINAL image is
+  gaussian-smoothed with ``sigma = bin_s / magnif`` (magnif 6,
+  ``VLFeat.cxx:85-91``), kernel radius ``ceil(4σ)``, edge ("continuity")
+  padding — vl_imsmooth behavior;
+- gradients by central differences, one-sided (not halved) at borders —
+  vl_dsift_process;
+- soft angular binning of the magnitude into 8 orientation planes;
+- bilinear spatial binning as a unit-integral triangular convolution of
+  each plane (vl_imconvcoltri, edge padding), POINT-SAMPLED at bin
+  corners ``frame + bin_index · bin_s`` — the flat-window trick: the
+  per-descriptor gaussian window is replaced by per-bin constant weights
+  ``w(i)·w(j)·bin_s²`` (``_vl_dsift_get_bin_window_mean`` with
+  windowSize 1.5);
+- keypoint grid: ``off = (1 + 2·num_scales) − 3·s`` clamped to 0
+  (``VLFeat.cxx:93-96``), frames up to ``dim − 3·bin_s − 1``, step
+  ``step + s·scale_step``;
+- descriptors L2-normalized, clamped at 0.2, renormalized; descriptors
+  whose PRE-normalization norm < 0.005 zeroed (the shim's
+  contrast-threshold copy-suppression, ``VLFeat.cxx:143-152``);
+- quantized ``min(trunc(512·v), 255)`` (``VLFeat.cxx:260-263``).
+
+Axis convention: the shim feeds vlfeat the transposed image (xDim=height,
+``SIFTExtractor.scala:82``, ``Image.scala:89-103``) and transposes each
+descriptor back (``vl_dsift_transpose_descriptor``). The net layout
+reproduced here: descriptor entries ordered (row-bin, col-bin,
+orientation) with orientation angle ``atan2(−gx, gy)``, keypoints
+ordered column-outer / row-inner, scales concatenated (the shim's
+``groupByPixels=false`` branch).
 
 Everything is one jitted program of convolutions and gathers — no host
-round-trip per image, unlike the JNI-per-image reference path. The spatial
-weighting uses bilinear (triangular) binning, vl_dsift's exact-SIFT mode
-(the shim enables the flat-window *approximation* for speed; bit-exact
-parity with vl_phow goldens is a known gap tracked for a later round).
-
-Output layout matches ``SIFTExtractor.scala``: per image a feature-major
-(128, num_descriptors) matrix, batched to (N, 128, M).
+round-trip per image, unlike the JNI-per-image reference path. Gated
+against an independent direct-summation golden (tests/goldens) with the
+reference tolerance: ≥99.5% of entries within ±1
+(``VLFeatSuite.scala:46-51``).
 """
 
 from __future__ import annotations
@@ -42,31 +59,76 @@ NUM_ORIENTATIONS = 8
 NUM_SPATIAL_BINS = 4
 DESC_DIM = NUM_ORIENTATIONS * NUM_SPATIAL_BINS * NUM_SPATIAL_BINS  # 128
 CONTRAST_THRESHOLD = 0.005
+WINDOW_SIZE = 1.5  # vl window size (VLFeat.cxx:103)
+MAGNIF = 6.0
 
 
 def gaussian_kernel(sigma: float) -> np.ndarray:
     radius = max(int(math.ceil(4.0 * sigma)), 1)
     x = np.arange(-radius, radius + 1, dtype=np.float32)
     k = np.exp(-0.5 * (x / max(sigma, 1e-8)) ** 2)
-    return k / k.sum()
+    return (k / k.sum()).astype(np.float32)
 
 
-def _smooth_edge_padded(img, k: np.ndarray):
-    """Gaussian smooth with edge replication (vl_imsmooth behavior) — plain
-    zero padding would manufacture gradients at the borders."""
+def triangular_kernel(bin_size: int) -> np.ndarray:
+    """vl_imconvcoltri: unit-INTEGRAL triangle over (−bin, bin)."""
+    u = np.arange(-bin_size + 1, bin_size, dtype=np.float32)
+    return (bin_size - np.abs(u)) / (bin_size * bin_size)
+
+
+def bin_window_mean(bin_size: int, bin_index: int) -> float:
+    """_vl_dsift_get_bin_window_mean: mean of the flat-window gaussian
+    (sigma = bin_size · windowSize) over one bin's triangle support."""
+    delta = bin_size * (bin_index - 0.5 * (NUM_SPATIAL_BINS - 1))
+    sigma = bin_size * WINDOW_SIZE
+    x = np.arange(-bin_size + 1, bin_size, dtype=np.float64)
+    z = (x - delta) / sigma
+    return float(np.mean(np.exp(-0.5 * z * z)))
+
+
+def _conv_edge_padded(img, k: np.ndarray):
+    """Separable convolution with edge replication (VL_PAD_BY_CONTINUITY)."""
     r = (len(k) - 1) // 2
-    padded = jnp.pad(img, ((0, 0), (r, r), (r, r)), mode="edge")
-    out = conv2d_separable(padded[..., None], k, k)[..., 0]
+    pad = ((0, 0), (r, r), (r, r)) + ((0, 0),) * (img.ndim - 3)
+    padded = jnp.pad(img, pad, mode="edge")
+    if img.ndim == 3:
+        out = conv2d_separable(padded[..., None], k, k)[..., 0]
+    else:
+        out = conv2d_separable(padded, k, k)
     return out[:, r:-r, r:-r] if r else out
 
 
+def _gradients(img):
+    """vl_dsift gradients: central differences, one-sided at borders."""
+    gr = jnp.concatenate(
+        [
+            (img[:, 1:2, :] - img[:, 0:1, :]),
+            0.5 * (img[:, 2:, :] - img[:, :-2, :]),
+            (img[:, -1:, :] - img[:, -2:-1, :]),
+        ],
+        axis=1,
+    )  # d/d(row)
+    gc = jnp.concatenate(
+        [
+            (img[:, :, 1:2] - img[:, :, 0:1]),
+            0.5 * (img[:, :, 2:] - img[:, :, :-2]),
+            (img[:, :, -1:] - img[:, :, -2:-1]),
+        ],
+        axis=2,
+    )  # d/d(col)
+    return gr, gc
+
+
 def _orientation_planes(img):
-    """(N, H, W) → (N, H, W, 8) soft-binned gradient magnitude planes."""
-    gy = jnp.pad(img[:, 2:, :] - img[:, :-2, :], ((0, 0), (1, 1), (0, 0))) * 0.5
-    gx = jnp.pad(img[:, :, 2:] - img[:, :, :-2], ((0, 0), (0, 0), (1, 1))) * 0.5
+    """(N, H, W) → (N, H, W, 8) soft-binned gradient magnitude planes.
+
+    Angle convention matches the shim's net transpose: θ = atan2(−gx, gy)
+    where gx is the column derivative and gy the row derivative.
+    """
+    gy, gx = _gradients(img)
     mag = jnp.sqrt(gx * gx + gy * gy)
-    angle = jnp.arctan2(gy, gx)  # [-pi, pi]
-    t = angle / (2 * jnp.pi / NUM_ORIENTATIONS)  # in bins
+    angle = jnp.arctan2(-gx, gy)
+    t = angle * (NUM_ORIENTATIONS / (2 * jnp.pi))
     t = jnp.mod(t, NUM_ORIENTATIONS)
     lo = jnp.floor(t)
     frac = t - lo
@@ -81,42 +143,47 @@ def _orientation_planes(img):
 
 
 def _scale_descriptors(img, bin_size: int, step: int, offset: int):
-    """Descriptors for one scale. img: (N, H, W) already smoothed.
+    """Flat-window descriptors for one scale. img: (N, H, W) smoothed.
 
-    Returns (N, num_kp, 128) unnormalized histograms.
+    Returns (N, num_kp, 128) unnormalized histograms in (row-bin,
+    col-bin, orientation) order, keypoints column-outer / row-inner.
     """
     n, h, w = img.shape
     planes = _orientation_planes(img)  # (N, H, W, 8)
-    # triangular spatial window of half-width bin_size (exact-SIFT mode)
-    tri = np.maximum(
-        0.0, 1.0 - np.abs(np.arange(-bin_size + 1, bin_size)) / bin_size
-    ).astype(np.float32)
-    acc = conv2d_separable(planes, tri, tri)  # (N, H, W, 8)
+    tri = triangular_kernel(bin_size)
+    acc = _conv_edge_padded(planes, tri)  # (N, H, W, 8)
 
-    support = NUM_SPATIAL_BINS * bin_size
-    # bin centers relative to descriptor corner (rounded to pixels)
-    centers = (np.arange(NUM_SPATIAL_BINS) * bin_size + (bin_size - 1) / 2.0)
-    centers = np.round(centers).astype(np.int32)
-    max_corner_y = h - support
-    max_corner_x = w - support
-    ys0 = np.arange(offset, max_corner_y + 1, step, dtype=np.int32)
-    xs0 = np.arange(offset, max_corner_x + 1, step, dtype=np.int32)
-    if len(ys0) == 0 or len(xs0) == 0:
+    frame_size = (NUM_SPATIAL_BINS - 1) * bin_size + 1
+    rs = np.arange(offset, h - frame_size + 1, step, dtype=np.int32)
+    cs = np.arange(offset, w - frame_size + 1, step, dtype=np.int32)
+    if len(rs) == 0 or len(cs) == 0:
         return jnp.zeros((n, 0, DESC_DIM), img.dtype)
 
-    row_idx = (ys0[:, None] + centers[None, :]).reshape(-1)  # (ky*4,)
-    col_idx = (xs0[:, None] + centers[None, :]).reshape(-1)  # (kx*4,)
+    bin_off = np.arange(NUM_SPATIAL_BINS, dtype=np.int32) * bin_size
+    row_idx = (rs[:, None] + bin_off[None, :]).reshape(-1)  # (kr·4,)
+    col_idx = (cs[:, None] + bin_off[None, :]).reshape(-1)  # (kc·4,)
     g = jnp.take(acc, jnp.asarray(row_idx), axis=1)
     g = jnp.take(g, jnp.asarray(col_idx), axis=2)
-    # (N, ky, 4, kx, 4, 8) → (N, ky, kx, 4, 4, 8)
-    g = g.reshape(n, len(ys0), NUM_SPATIAL_BINS, len(xs0), NUM_SPATIAL_BINS, NUM_ORIENTATIONS)
-    g = jnp.transpose(g, (0, 1, 3, 2, 4, 5))
-    return g.reshape(n, len(ys0) * len(xs0), DESC_DIM)
+    # (N, kr, 4, kc, 4, 8) → keypoints column-outer: (N, kc, kr, 4, 4, 8)
+    g = g.reshape(
+        n, len(rs), NUM_SPATIAL_BINS, len(cs), NUM_SPATIAL_BINS,
+        NUM_ORIENTATIONS,
+    )
+    g = jnp.transpose(g, (0, 3, 1, 2, 4, 5))
+    # flat-window bin weights: w(i)·w(j)·bin² (triangle conv is
+    # unit-integral; SIFT wants unit height → ×bin per axis)
+    wmean = np.array(
+        [bin_window_mean(bin_size, i) for i in range(NUM_SPATIAL_BINS)],
+        np.float32,
+    ) * bin_size
+    g = g * (wmean[:, None, None] * wmean[None, :, None])
+    return g.reshape(n, len(rs) * len(cs), DESC_DIM)
 
 
 def _finalize(desc):
-    """SIFT normalization: L2 → clamp 0.2 → re-L2 → quantize min(512v, 255);
-    zero out low-contrast descriptors (pre-norm norm < 0.005)."""
+    """vl_dsift + shim post-processing: L2 → clamp 0.2 → re-L2 →
+    quantize min(trunc(512v), 255); zero descriptors whose
+    pre-normalization norm < 0.005 (the shim's contrast threshold)."""
     norm = jnp.linalg.norm(desc, axis=-1, keepdims=True)
     d = desc / jnp.maximum(norm, 1e-10)
     d = jnp.minimum(d, 0.2)
@@ -127,12 +194,12 @@ def _finalize(desc):
 
 @treenode
 class SIFTExtractor(Transformer):
-    """Multi-scale dense SIFT (reference external.SIFTExtractor defaults:
-    step 3, bin 4, 5 scales, scale_step 0).
+    """Multi-scale dense SIFT (reference external.SIFTExtractor; the VOC
+    pipeline uses step 3, bin 4, 5 scales, scale_step 0).
 
     Input: (N, H, W) or (N, H, W, 1) grayscale in [0, 1].
-    Output: (N, 128, M) quantized descriptors, scales concatenated in order
-    (the shim's no-grouping concat path).
+    Output: (N, 128, M) quantized descriptors, scales concatenated in
+    order (the shim's groupByPixels=false concat path).
     """
 
     step: int = static_field(default=3)
@@ -157,9 +224,9 @@ def _sift_multiscale(
     outs = []
     for s in range(num_scales):
         bin_s = bin_size + 2 * s
-        sigma = bin_s / 6.0
+        sigma = bin_s / MAGNIF
         k = gaussian_kernel(sigma)
-        smoothed = _smooth_edge_padded(img, k)
+        smoothed = _conv_edge_padded(img, k)
         offset = max((1 + 2 * num_scales) - 3 * s, 0)
         desc = _scale_descriptors(
             smoothed, bin_s, step + s * scale_step, offset

@@ -46,21 +46,21 @@ def test_sift_descriptor_count_formula():
     for s in range(2):
         bin_s = 4 + 2 * s
         off = (1 + 2 * 2) - 3 * s
-        support = 4 * bin_s
-        ks = len(range(off, h - support + 1, 3))
+        frame = 3 * bin_s + 1  # vl_dsift: binSize·(numBins−1)+1
+        ks = len(range(off, h - frame + 1, 3))
         total += ks * ks
     assert out.shape == (1, 128, total)
 
 
 def test_sift_vertical_edge_orientation(rng):
-    """A vertical step edge concentrates energy in the horizontal-gradient
-    orientation bins (0 or 4 = ±x)."""
+    """A vertical step edge has a pure column gradient; under the shim's
+    net angle convention θ = atan2(−gx, gy) that is bin 2 or 6."""
     img = np.zeros((1, 48, 48), np.float32)
     img[:, :, 24:] = 1.0
     out = np.asarray(SIFTExtractor(num_scales=1)(jnp.asarray(img)))
     desc = out[0].reshape(128, -1).sum(axis=1).reshape(4, 4, 8)
     by_orientation = desc.sum(axis=(0, 1))
-    assert by_orientation.argmax() in (0, 4)
+    assert by_orientation.argmax() in (2, 6)
 
 
 def test_lcs_shapes_and_constant_image(rng):

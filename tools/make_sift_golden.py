@@ -1,0 +1,206 @@
+"""Generate dense-SIFT golden fixtures by DIRECT summation.
+
+Independent reference implementation of the vl_dsift flat-window
+algorithm (the semantics of the reference shim, VLFeat.cxx:68-123): pure
+numpy, explicit per-keypoint/per-bin loops over the triangle support with
+edge clamping — no convolution/gather shortcuts shared with the fast
+implementation in keystone_tpu/ops/sift.py. The goldens gate the fast
+path with the reference tolerance (≥99.5% of entries within ±1,
+VLFeatSuite.scala:46-51).
+
+Inputs: the reference's own VOC fixture image (000012.jpg, downscaled)
+and a deterministic synthetic image. Run from the repo root:
+
+    python tools/make_sift_golden.py
+"""
+
+from __future__ import annotations
+
+import math
+import pathlib
+import sys
+
+import numpy as np
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+GOLDEN_DIR = REPO / "tests" / "goldens"
+REF_IMAGE = pathlib.Path("/root/reference/src/test/resources/images/000012.jpg")
+
+NUM_T = 8
+NUM_B = 4
+WINDOW_SIZE = 1.5
+MAGNIF = 6.0
+CONTRAST = 0.005
+
+
+def smooth(img: np.ndarray, sigma: float) -> np.ndarray:
+    """Gaussian smoothing, radius ceil(4σ), edge-clamped, separable."""
+    radius = max(int(math.ceil(4.0 * sigma)), 1)
+    x = np.arange(-radius, radius + 1, dtype=np.float64)
+    k = np.exp(-0.5 * (x / max(sigma, 1e-8)) ** 2)
+    k /= k.sum()
+    h, w = img.shape
+    tmp = np.zeros_like(img, dtype=np.float64)
+    out = np.zeros_like(img, dtype=np.float64)
+    for r in range(h):
+        for c in range(w):
+            acc = 0.0
+            for i, kv in enumerate(k):
+                cc = min(max(c + i - radius, 0), w - 1)
+                acc += kv * img[r, cc]
+            tmp[r, c] = acc
+    for r in range(h):
+        for c in range(w):
+            acc = 0.0
+            for i, kv in enumerate(k):
+                rr = min(max(r + i - radius, 0), h - 1)
+                acc += kv * tmp[rr, c]
+            out[r, c] = acc
+    return out
+
+
+def gradients(img: np.ndarray):
+    h, w = img.shape
+    gy = np.zeros_like(img)  # d/d(row)
+    gx = np.zeros_like(img)  # d/d(col)
+    gy[0, :] = img[1, :] - img[0, :]
+    gy[-1, :] = img[-1, :] - img[-2, :]
+    gy[1:-1, :] = 0.5 * (img[2:, :] - img[:-2, :])
+    gx[:, 0] = img[:, 1] - img[:, 0]
+    gx[:, -1] = img[:, -1] - img[:, -2]
+    gx[:, 1:-1] = 0.5 * (img[:, 2:] - img[:, :-2])
+    return gy, gx
+
+
+def orientation_planes(img: np.ndarray) -> np.ndarray:
+    """(H, W, 8) soft-binned magnitude planes, angle atan2(−gx, gy)."""
+    gy, gx = gradients(img)
+    mag = np.sqrt(gx * gx + gy * gy)
+    angle = np.arctan2(-gx, gy)
+    nt = np.mod(angle * (NUM_T / (2 * np.pi)), NUM_T)
+    lo = np.floor(nt).astype(int) % NUM_T
+    frac = nt - np.floor(nt)
+    planes = np.zeros(img.shape + (NUM_T,))
+    h, w = img.shape
+    for r in range(h):
+        for c in range(w):
+            planes[r, c, lo[r, c]] += mag[r, c] * (1 - frac[r, c])
+            planes[r, c, (lo[r, c] + 1) % NUM_T] += mag[r, c] * frac[r, c]
+    return planes
+
+
+def bin_window_mean(bin_size: int, bin_index: int) -> float:
+    delta = bin_size * (bin_index - 0.5 * (NUM_B - 1))
+    sigma = bin_size * WINDOW_SIZE
+    xs = np.arange(-bin_size + 1, bin_size, dtype=np.float64)
+    return float(np.mean(np.exp(-0.5 * ((xs - delta) / sigma) ** 2)))
+
+
+def descriptor_at(planes: np.ndarray, r0: int, c0: int, b: int) -> np.ndarray:
+    """One flat-window descriptor at frame corner (r0, c0), bin size b.
+
+    Direct summation: bin (i, j) samples the triangular-weighted sum of
+    the plane around (r0 + i·b, c0 + j·b), edge-clamped, scaled by the
+    flat-window mean weights. Layout (row-bin, col-bin, orientation)."""
+    h, w, _ = planes.shape
+    wmeans = [bin_window_mean(b, i) * b for i in range(NUM_B)]
+    desc = np.zeros((NUM_B, NUM_B, NUM_T))
+    for i in range(NUM_B):  # row bin
+        for j in range(NUM_B):  # col bin
+            sr, sc = r0 + i * b, c0 + j * b
+            acc = np.zeros(NUM_T)
+            for dr in range(-b + 1, b):
+                wr = (b - abs(dr)) / (b * b)
+                rr = min(max(sr + dr, 0), h - 1)
+                for dc in range(-b + 1, b):
+                    wc = (b - abs(dc)) / (b * b)
+                    cc = min(max(sc + dc, 0), w - 1)
+                    acc += planes[rr, cc] * (wr * wc)
+            desc[i, j] = acc * (wmeans[i] * wmeans[j])
+    return desc.reshape(-1)
+
+
+def finalize(desc: np.ndarray) -> np.ndarray:
+    norm = np.linalg.norm(desc)
+    if norm < CONTRAST:
+        return np.zeros_like(desc)
+    d = desc / max(norm, 1e-10)
+    d = np.minimum(d, 0.2)
+    d = d / max(np.linalg.norm(d), 1e-10)
+    return np.minimum(np.floor(512.0 * d), 255.0)
+
+
+def dsift_direct(
+    img: np.ndarray, step: int, bin_size: int, num_scales: int,
+    scale_step: int,
+) -> np.ndarray:
+    """(M, 128) descriptors, scales concatenated, keypoints
+    column-outer / row-inner (the shim's frame order)."""
+    h, w = img.shape
+    out = []
+    for s in range(num_scales):
+        b = bin_size + 2 * s
+        smoothed = smooth(img, b / MAGNIF)
+        planes = orientation_planes(smoothed)
+        off = max((1 + 2 * num_scales) - 3 * s, 0)
+        frame = (NUM_B - 1) * b + 1
+        st = step + s * scale_step
+        for c0 in range(off, w - frame + 1, st):
+            for r0 in range(off, h - frame + 1, st):
+                out.append(finalize(descriptor_at(planes, r0, c0, b)))
+    return np.stack(out) if out else np.zeros((0, 128))
+
+
+def load_gray(path: pathlib.Path, max_dim: int = 48) -> np.ndarray:
+    from PIL import Image
+
+    im = Image.open(path).convert("RGB")
+    scale = max_dim / max(im.size)
+    im = im.resize(
+        (max(int(im.size[0] * scale), 8), max(int(im.size[1] * scale), 8)),
+        Image.BILINEAR,
+    )
+    arr = np.asarray(im, np.float64) / 255.0
+    # NTSC grayscale, reference ImageUtils.toGrayScale coefficients
+    return 0.299 * arr[..., 0] + 0.587 * arr[..., 1] + 0.114 * arr[..., 2]
+
+
+def synthetic(h: int = 40, w: int = 52) -> np.ndarray:
+    rng = np.random.default_rng(12345)
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float64)
+    img = 0.5 + 0.3 * np.sin(xx / 5.0) * np.cos(yy / 7.0)
+    img += 0.15 * rng.standard_normal((h, w))
+    return np.clip(img, 0.0, 1.0)
+
+
+def main() -> int:
+    GOLDEN_DIR.mkdir(parents=True, exist_ok=True)
+    cases = {"synthetic": synthetic()}
+    if REF_IMAGE.exists():
+        cases["voc000012"] = load_gray(REF_IMAGE)
+    params = dict(step=4, bin_size=4, num_scales=2, scale_step=0)
+    for name, img in cases.items():
+        desc = dsift_direct(img, **params)
+        header = (
+            f"h={img.shape[0]} w={img.shape[1]} "
+            + " ".join(f"{k}={v}" for k, v in params.items())
+        )
+        np.savetxt(
+            GOLDEN_DIR / f"sift_{name}.csv",
+            desc,
+            fmt="%d",
+            delimiter=",",
+            header=header,
+        )
+        np.savetxt(
+            GOLDEN_DIR / f"sift_{name}_input.csv",
+            img,
+            fmt="%.8f",
+            delimiter=",",
+        )
+        print(f"{name}: img {img.shape}, {desc.shape[0]} descriptors")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
