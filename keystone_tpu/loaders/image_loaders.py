@@ -165,20 +165,20 @@ def load_voc(
     return LabeledImages(labels=labels, images=images)
 
 
-def load_imagenet(
-    tar_path: str, class_map_path: str, *, target_size: int | None = 256
-) -> LabeledImages:
-    """ImageNet tar(s) + "dirname class_index" map file → labeled images
-    (reference ImageNetLoader: label from the synset prefix of the entry
-    name via the map file)."""
+def load_class_map(class_map_path: str) -> dict[str, int]:
+    """Parse a "synset class_index" map file (reference ImageNetLoader)."""
     class_map: dict[str, int] = {}
     with open(class_map_path) as f:
         for line in f:
             parts = line.split()
             if len(parts) >= 2:
                 class_map[parts[0]] = int(parts[1])
+    return class_map
 
-    names, images = load_tar_images(_expand(tar_path, ".tar"), target_size)
+
+def make_synset_label_of(class_map: dict[str, int]):
+    """name → class index: synset prefix of the basename, falling back to
+    the parent directory name; −1 when unmapped."""
 
     def label_of(name: str) -> int:
         base = os.path.basename(name)
@@ -188,6 +188,17 @@ def load_imagenet(
         parent = os.path.basename(os.path.dirname(name))
         return class_map.get(parent, -1)
 
+    return label_of
+
+
+def load_imagenet(
+    tar_path: str, class_map_path: str, *, target_size: int | None = 256
+) -> LabeledImages:
+    """ImageNet tar(s) + "dirname class_index" map file → labeled images
+    (reference ImageNetLoader: label from the synset prefix of the entry
+    name via the map file)."""
+    label_of = make_synset_label_of(load_class_map(class_map_path))
+    names, images = load_tar_images(_expand(tar_path, ".tar"), target_size)
     labels = np.asarray([label_of(n) for n in names], np.int32)
     unmapped = labels < 0
     if unmapped.any():

@@ -58,6 +58,41 @@ class FisherBranch:
         self.pca: BatchPCATransformer | None = None
         self.post = None
 
+    def _fit_pca(self, sample_fn) -> None:
+        """Artifact-aware PCA fit; ``sample_fn()`` supplies (n, d) rows."""
+        if self.pca_file and os.path.exists(self.pca_file):
+            pca_mat = jnp.asarray(
+                np.loadtxt(self.pca_file, delimiter=",", ndmin=2), jnp.float32
+            )
+            logger.info("loaded PCA from %s", self.pca_file)
+        else:
+            pca_mat = compute_pca(sample_fn(), self.desc_dim)
+            if self.pca_file:
+                np.savetxt(self.pca_file, np.asarray(pca_mat), delimiter=",")
+        self.pca = BatchPCATransformer(pca_mat=pca_mat)
+
+    def _fit_gmm_and_post(self, proj_sample_fn) -> None:
+        """Artifact-aware GMM fit + the 5-stage fisher post chain;
+        ``proj_sample_fn()`` supplies (n, desc_dim) projected rows."""
+        if all(self.gmm_files) and all(
+            os.path.exists(f) for f in self.gmm_files
+        ):
+            gmm = GaussianMixtureModel.load_csv(*self.gmm_files)
+            logger.info("loaded GMM from %s", self.gmm_files[0])
+        else:
+            gmm = GaussianMixtureModelEstimator(k=self.vocab_size).fit(
+                proj_sample_fn()
+            )
+            if all(self.gmm_files):
+                gmm.save_csv(*self.gmm_files)
+        self.post = (
+            FisherVector(gmm=gmm)
+            >> MatrixVectorizer()
+            >> NormalizeRows()
+            >> SignedHellingerMapper()
+            >> NormalizeRows()
+        )
+
     def fit(self, train_desc, chunk_size: int, n_valid: int | None = None):
         """Fit PCA/GMM (artifact-aware) and return the projected train
         descriptors (reused by featurize of the training set).
@@ -67,42 +102,32 @@ class FisherBranch:
         PCA/GMM sample (they would otherwise seed a spurious zero cluster).
         """
         fit_desc = train_desc if n_valid is None else train_desc[:n_valid]
-        if self.pca_file and os.path.exists(self.pca_file):
-            pca_mat = jnp.asarray(
-                np.loadtxt(self.pca_file, delimiter=",", ndmin=2), jnp.float32
-            )
-            logger.info("loaded PCA from %s", self.pca_file)
-        else:
-            sample = sample_columns(fit_desc, self.num_pca_samples, self.seed)
-            pca_mat = compute_pca(sample, self.desc_dim)
-            if self.pca_file:
-                np.savetxt(self.pca_file, np.asarray(pca_mat), delimiter=",")
-        self.pca = BatchPCATransformer(pca_mat=pca_mat)
-
+        self._fit_pca(
+            lambda: sample_columns(fit_desc, self.num_pca_samples, self.seed)
+        )
         projected = apply_in_chunks(
             lambda d: _apply_node(self.pca, d), train_desc, chunk_size
         )
-
-        if all(self.gmm_files) and all(
-            os.path.exists(f) for f in self.gmm_files
-        ):
-            gmm = GaussianMixtureModel.load_csv(*self.gmm_files)
-            logger.info("loaded GMM from %s", self.gmm_files[0])
-        else:
-            proj_fit = projected if n_valid is None else projected[:n_valid]
-            sample = sample_columns(proj_fit, self.num_gmm_samples, self.seed + 1)
-            gmm = GaussianMixtureModelEstimator(k=self.vocab_size).fit(sample)
-            if all(self.gmm_files):
-                gmm.save_csv(*self.gmm_files)
-
-        self.post = (
-            FisherVector(gmm=gmm)
-            >> MatrixVectorizer()
-            >> NormalizeRows()
-            >> SignedHellingerMapper()
-            >> NormalizeRows()
+        proj_fit = projected if n_valid is None else projected[:n_valid]
+        self._fit_gmm_and_post(
+            lambda: sample_columns(
+                proj_fit, self.num_gmm_samples, self.seed + 1
+            )
         )
         return projected
+
+    def fit_from_samples(self, sample_cols) -> None:
+        """Fit PCA + GMM from a bounded descriptor-column sample (n, d) —
+        the streaming path: the sample comes from a
+        :class:`keystone_tpu.loaders.streaming.ColumnReservoir` filled
+        across the corpus instead of from materialized descriptors."""
+        sample_cols = jnp.asarray(sample_cols, jnp.float32)
+        self._fit_pca(lambda: sample_cols)
+        self._fit_gmm_and_post(
+            lambda: (sample_cols @ self.pca.pca_mat)[
+                : self.num_gmm_samples
+            ]
+        )
 
     def featurize_projected(self, projected, chunk_size: int):
         return apply_in_chunks(

@@ -59,6 +59,12 @@ class ImageNetConfig:
     seed: int = arg(default=0)
     synthetic: int = arg(default=0, help="if > 0, N synthetic images")
     synthetic_classes: int = arg(default=8)
+    streaming: bool = arg(
+        default=False,
+        help="two-pass streaming ingestion: never materializes the image "
+        "corpus or its descriptors on the host (ImageNet-scale)",
+    )
+    stream_batch: int = arg(default=256, help="host images per stream batch")
 
 
 def _load(conf: ImageNetConfig, which: str) -> tuple[LabeledImages, int]:
@@ -87,6 +93,259 @@ def _load(conf: ImageNetConfig, which: str) -> tuple[LabeledImages, int]:
         target_size=conf.image_size,
     )
     return data, conf.num_classes
+
+
+def _descriptor_cols(desc) -> np.ndarray:
+    """(N, d, m) device descriptors → (N·m, d) host rows for the reservoir."""
+    n, d, m = desc.shape
+    return np.asarray(jnp.transpose(desc, (0, 2, 1)).reshape(n * m, d))
+
+
+def _tar_source(conf: ImageNetConfig, which: str):
+    """Re-streamable batch source over the tar corpus: each call returns a
+    fresh iterator of (images, labels) host batches (this process's share
+    of the tar files)."""
+    import jax as _jax
+
+    from keystone_tpu.loaders.image_loaders import (
+        load_class_map,
+        make_synset_label_of,
+    )
+    from keystone_tpu.loaders.streaming import iter_tar_image_batches
+
+    label_of = make_synset_label_of(load_class_map(conf.label_map))
+    location = conf.train_location if which == "train" else conf.test_location
+
+    def source():
+        for _, imgs, labels in iter_tar_image_batches(
+            location,
+            batch_size=conf.stream_batch,
+            target_size=conf.image_size,
+            label_of=label_of,
+            process_index=_jax.process_index(),
+            process_count=_jax.process_count(),
+        ):
+            yield imgs, labels
+
+    return source
+
+
+def _synthetic_source(conf: ImageNetConfig, which: str):
+    """Serve the synthetic corpus through the streaming iterator contract."""
+    data, _ = _load(conf, which)
+
+    def source():
+        for s in range(0, len(data.labels), conf.stream_batch):
+            yield (
+                data.images[s : s + conf.stream_batch],
+                data.labels[s : s + conf.stream_batch],
+            )
+
+    return source
+
+
+def _assemble_global(features: np.ndarray, labels: np.ndarray):
+    """Combine every process's local (n_p, D) features + labels into the
+    global training set (each process streamed a disjoint tar shard).
+
+    Features are small relative to images (the whole point of streaming),
+    so an allgather-and-concatenate keeps the solver's simple
+    prefix-validity contract — the same host footprint the eager path
+    already pays for its feature matrix. Single-process: passthrough.
+    """
+    import jax as _jax
+
+    if _jax.process_count() == 1:
+        return features, labels
+    from jax.experimental import multihost_utils
+
+    counts = multihost_utils.process_allgather(
+        np.asarray([len(features)], np.int64)
+    ).ravel()
+    n_max = int(counts.max())
+    pad_f = np.zeros((n_max, features.shape[1]), features.dtype)
+    pad_f[: len(features)] = features
+    pad_y = np.zeros((n_max,), labels.dtype)
+    pad_y[: len(labels)] = labels
+    all_f = multihost_utils.process_allgather(pad_f)  # (P, n_max, D)
+    all_y = multihost_utils.process_allgather(pad_y)
+    feats = np.concatenate(
+        [all_f[p, : counts[p]] for p in range(len(counts))]
+    )
+    labs = np.concatenate(
+        [all_y[p, : counts[p]] for p in range(len(counts))]
+    )
+    return feats, labs
+
+
+def run_streaming(
+    conf: ImageNetConfig, mesh=None, train_source=None, test_source=None
+) -> dict:
+    """Two-pass streaming variant of :func:`run` — ImageNet-scale.
+
+    Pass 1 streams the corpus once, filling bounded descriptor-column
+    reservoirs (PCA/GMM samples); pass 2 streams it again, emitting only
+    the Fisher features + labels. Host memory never holds more than one
+    image batch + the reservoirs + the feature matrix — the reference's
+    per-executor tar streaming economics (ImageLoaderUtils.scala:177-216).
+    Sources are callables returning a fresh (images, labels) iterator,
+    defaulting to this process's share of the tar corpus; multi-host, each
+    process streams a disjoint file set and the per-process features are
+    assembled into one global training set before the fit.
+    """
+    from keystone_tpu.loaders.streaming import ColumnReservoir
+
+    if mesh is None and len(jax.devices()) > 1:
+        mesh = create_mesh()
+    if train_source is None:
+        train_source = (
+            _synthetic_source(conf, "train")
+            if conf.synthetic
+            else _tar_source(conf, "train")
+        )
+    if test_source is None:
+        test_source = (
+            _synthetic_source(conf, "test")
+            if conf.synthetic
+            else _tar_source(conf, "test")
+        )
+    num_classes = (
+        conf.synthetic_classes if conf.synthetic else conf.num_classes
+    )
+    t0 = time.perf_counter()
+
+    gray = PixelScaler() >> GrayScaler()
+    sift = SIFTExtractor(num_scales=conf.sift_scales)
+    lcs = LCSExtractor(
+        stride=conf.lcs_stride,
+        stride_start=conf.lcs_border,
+        sub_patch_size=conf.lcs_patch,
+    )
+    sift_fn = jax.jit(lambda b: sift(gray(b)))
+    lcs_fn = jax.jit(lambda b: lcs(PixelScaler()(b)))
+
+    sift_branch = FisherBranch(
+        conf.desc_dim, conf.vocab_size, conf.num_pca_samples,
+        conf.num_gmm_samples, conf.seed,
+    )
+    lcs_branch = FisherBranch(
+        conf.desc_dim, conf.vocab_size, conf.num_pca_samples,
+        conf.num_gmm_samples, conf.seed + 100,
+    )
+
+    # ---- pass 1: bounded descriptor-column reservoirs (PCA/GMM) ----
+    res_sift = ColumnReservoir(conf.num_pca_samples, conf.seed)
+    res_lcs = ColumnReservoir(conf.num_pca_samples, conf.seed + 1)
+    for imgs, _ in train_source():
+        res_sift.add(
+            _descriptor_cols(apply_in_chunks(sift_fn, imgs, conf.chunk_size))
+        )
+        res_lcs.add(
+            _descriptor_cols(apply_in_chunks(lcs_fn, imgs, conf.chunk_size))
+        )
+    sift_branch.fit_from_samples(res_sift.sample())
+    lcs_branch.fit_from_samples(res_lcs.sample())
+    t_sample = time.perf_counter()
+
+    # ---- pass 2: featurize stream → (N, D) fisher features + labels.
+    # One jitted executable (fixed chunk shape, mesh-sharded) serves every
+    # chunk of both the train and test streams.
+    featurize_chunk = jax.jit(
+        lambda b: ZipVectors()(
+            [
+                _branch_apply(sift_branch, sift_fn(b)),
+                _branch_apply(lcs_branch, lcs_fn(b)),
+            ]
+        )
+    )
+
+    def features_labels_of(source):
+        from keystone_tpu.loaders.streaming import featurize_stream
+
+        label_parts: list[np.ndarray] = []
+
+        def image_batches():
+            for imgs, labels in source():
+                label_parts.append(np.asarray(labels, np.int32))
+                yield imgs
+
+        feats = featurize_stream(
+            image_batches(), featurize_chunk,
+            chunk_size=conf.chunk_size, mesh=mesh,
+        )
+        labels = (
+            np.concatenate(label_parts)
+            if label_parts
+            else np.zeros(0, np.int32)
+        )
+        return feats, labels
+
+    f_train_local, y_train_local = features_labels_of(train_source)
+    f_train_np, y_train = _assemble_global(f_train_local, y_train_local)
+    n_train = len(y_train)
+    f_train = shard_batch(f_train_np, mesh)
+    t_feat = time.perf_counter()
+
+    y_pad = np.zeros(f_train.shape[0], np.int32)
+    y_pad[:n_train] = y_train
+    indicators = ClassLabelIndicators(num_classes=num_classes)(
+        jnp.asarray(y_pad)
+    )
+    est = BlockWeightedLeastSquaresEstimator(
+        block_size=conf.block_size,
+        num_iter=conf.num_iter,
+        lam=conf.lam,
+        mixture_weight=conf.mixture_weight,
+        class_chunk=min(16, num_classes),
+    )
+    model = jax.block_until_ready(
+        est.fit(f_train, indicators, n_valid=n_train)
+    )
+    t_fit = time.perf_counter()
+
+    top5 = TopKClassifier(k=min(5, num_classes))
+    evaluator = MulticlassClassifierEvaluator(num_classes)
+
+    def top_errors(scores, labels_np):
+        topk = np.asarray(top5(scores))[: len(labels_np)]
+        top1 = evaluator(
+            jnp.asarray(topk[:, 0]), jnp.asarray(labels_np)
+        ).error
+        top5_err = 1.0 - float(
+            np.mean((topk == labels_np[:, None]).any(axis=1))
+        )
+        return top1, top5_err
+
+    train_top1, train_top5 = top_errors(model(f_train), y_train)
+    f_test_local, y_test_local = features_labels_of(test_source)
+    f_test, y_test = _assemble_global(f_test_local, y_test_local)
+    test_top1, test_top5 = top_errors(
+        model(shard_batch(f_test, mesh)), y_test
+    )
+
+    result = {
+        "train_top1_error": train_top1,
+        "train_top5_error": train_top5,
+        "test_top1_error": test_top1,
+        "test_top5_error": test_top5,
+        "n_train": n_train,
+        "n_test": len(y_test),
+        "sample_pass_s": t_sample - t0,
+        "featurize_s": t_feat - t_sample,
+        "fit_s": t_fit - t_feat,
+        "total_s": time.perf_counter() - t0,
+    }
+    logger.info(
+        "ImageNetSiftLcsFV[streaming]: train top1/top5 err %.4f/%.4f, "
+        "test top1/top5 err %.4f/%.4f (%d train imgs)",
+        train_top1, train_top5, test_top1, test_top5, n_train,
+    )
+    return result
+
+
+def _branch_apply(branch: FisherBranch, desc):
+    """Project + fisher-post one descriptor batch (traced path)."""
+    return branch.post(branch.pca(desc))
 
 
 def run(conf: ImageNetConfig, mesh=None) -> dict:
@@ -214,6 +473,8 @@ def main(argv=None) -> dict:
         raise SystemExit(
             "need --train-location/--test-location/--label-map, or --synthetic N"
         )
+    if conf.streaming:
+        return run_streaming(conf)
     return run(conf)
 
 

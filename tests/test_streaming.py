@@ -1,0 +1,175 @@
+"""Streaming ingestion (reference ImageLoaderUtils.scala:177-216 —
+per-executor tar streaming): incremental tar decode, bounded-memory
+reservoir sampling, and the two-pass streaming ImageNet pipeline."""
+
+import io
+import os
+import resource
+import tarfile
+
+import jax
+import numpy as np
+import pytest
+
+from keystone_tpu.loaders.streaming import (
+    ColumnReservoir,
+    featurize_stream,
+    iter_tar_image_batches,
+)
+
+
+def _make_tar(path, entries):
+    """entries: list of (name, (H, W, 3) uint8 array) written as JPEGs."""
+    from PIL import Image
+
+    with tarfile.open(path, "w") as tf:
+        for name, arr in entries:
+            buf = io.BytesIO()
+            Image.fromarray(arr).save(buf, format="JPEG")
+            data = buf.getvalue()
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+
+
+@pytest.fixture
+def tars(tmp_path, rng):
+    paths = []
+    for t in range(2):
+        entries = [
+            (
+                f"n{t:02d}_{i}.jpg",
+                rng.integers(0, 255, (24, 24, 3)).astype(np.uint8),
+            )
+            for i in range(8)
+        ]
+        p = tmp_path / f"part{t}.tar"
+        _make_tar(p, entries)
+        paths.append(str(p))
+    return paths
+
+
+def test_iter_tar_batches_shapes_and_labels(tars):
+    batches = list(
+        iter_tar_image_batches(
+            tars,
+            batch_size=5,
+            target_size=16,
+            label_of=lambda name: int(os.path.basename(name)[1:3]),
+        )
+    )
+    names = [n for b in batches for n in b[0]]
+    labels = np.concatenate([b[2] for b in batches])
+    assert len(names) == 16
+    assert all(b[1].shape[1:] == (16, 16, 3) for b in batches)
+    assert max(len(b[0]) for b in batches) <= 5
+    # label derived per entry name
+    assert set(labels.tolist()) == {0, 1}
+
+
+def test_iter_tar_batches_process_sharding(tars):
+    seen = []
+    for pi in range(2):
+        for b in iter_tar_image_batches(
+            tars, batch_size=64, target_size=16,
+            process_index=pi, process_count=2,
+        ):
+            seen.append((pi, tuple(b[0])))
+    names0 = [n for pi, ns in seen for n in ns if pi == 0]
+    names1 = [n for pi, ns in seen for n in ns if pi == 1]
+    # disjoint file shards covering everything
+    assert len(names0) == len(names1) == 8
+    assert not (set(names0) & set(names1))
+
+
+def test_iter_tar_batches_negative_label_skipped(tars):
+    batches = list(
+        iter_tar_image_batches(
+            tars, batch_size=64, target_size=16,
+            label_of=lambda name: -1 if "n00" in name else 3,
+        )
+    )
+    labels = np.concatenate([b[2] for b in batches])
+    assert len(labels) == 8 and (labels == 3).all()
+
+
+def test_column_reservoir_uniformish(rng):
+    res = ColumnReservoir(capacity=200, seed=0)
+    for start in range(0, 10_000, 500):
+        rows = np.arange(start, start + 500, dtype=np.float32)[:, None]
+        res.add(np.repeat(rows, 3, axis=1))
+    s = res.sample()
+    assert s.shape == (200, 3)
+    # roughly uniform over the stream: mean near 5000, early/late both hit
+    assert 3000 < s[:, 0].mean() < 7000
+    assert (s[:, 0] < 2000).any() and (s[:, 0] > 8000).any()
+
+
+def test_column_reservoir_under_capacity(rng):
+    res = ColumnReservoir(capacity=100, seed=0)
+    res.add(rng.normal(size=(30, 4)).astype(np.float32))
+    assert res.sample().shape == (30, 4)
+
+
+def test_featurize_stream_bounded_memory_100k():
+    """VERDICT gate: >=100k images through the streaming featurizer with
+    bounded RSS — far below what materializing the corpus would take."""
+    import jax.numpy as jnp
+
+    n_chunks, chunk = 200, 512  # 102,400 images
+    h = w = 16
+    corpus_bytes = n_chunks * chunk * h * w * 3 * 4  # ~315 MB
+
+    def gen():
+        rng = np.random.default_rng(0)
+        for _ in range(n_chunks):
+            yield rng.normal(size=(chunk, h, w, 3)).astype(np.float32)
+
+    fn = jax.jit(lambda b: jnp.mean(b, axis=(1, 2)))  # (B, 3)
+    rss_before = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    feats = featurize_stream(gen(), fn, chunk_size=chunk)
+    rss_after = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    assert feats.shape == (n_chunks * chunk, 3)
+    delta_bytes = (rss_after - rss_before) * 1024  # ru_maxrss is KB on linux
+    assert delta_bytes < corpus_bytes / 2, (
+        f"RSS grew {delta_bytes/1e6:.0f}MB — corpus is {corpus_bytes/1e6:.0f}MB"
+    )
+
+
+def test_imagenet_streaming_matches_eager_shape(mesh8):
+    """Two-pass streaming ImageNet produces sane metrics on a synthetic
+    in-memory source (the tar source shares the same iterator contract)."""
+    from keystone_tpu.models import imagenet_sift_lcs_fv as m
+
+    conf = m.ImageNetConfig(
+        synthetic=48,
+        synthetic_classes=4,
+        num_classes=4,
+        image_size=32,
+        desc_dim=8,
+        vocab_size=2,
+        num_pca_samples=2000,
+        num_gmm_samples=2000,
+        chunk_size=8,
+        block_size=256,
+        sift_scales=1,
+        lcs_stride=8,
+        lcs_border=8,
+        lam=1e-3,
+    )
+    train, k = m._load(conf, "train")
+    test, _ = m._load(conf, "test")
+
+    def src(data):
+        def it():
+            for s in range(0, len(data.labels), 16):
+                yield data.images[s : s + 16], data.labels[s : s + 16]
+
+        return it
+
+    res = m.run_streaming(
+        conf, mesh=None, train_source=src(train), test_source=src(test)
+    )
+    assert res["n_train"] == 48
+    assert res["train_top1_error"] <= 0.6  # separable synthetic classes
+    assert 0.0 <= res["test_top5_error"] <= 1.0
