@@ -122,7 +122,7 @@ class FisherBranch:
         :class:`keystone_tpu.loaders.streaming.ColumnReservoir` filled
         across the corpus instead of from materialized descriptors."""
         sample_cols = jnp.asarray(sample_cols, jnp.float32)
-        self._fit_pca(lambda: sample_cols)
+        self._fit_pca(lambda: sample_cols[: self.num_pca_samples])
         self._fit_gmm_and_post(
             lambda: (sample_cols @ self.pca.pca_mat)[
                 : self.num_gmm_samples

@@ -159,13 +159,18 @@ def _assemble_global(features: np.ndarray, labels: np.ndarray):
         return features, labels
     from jax.experimental import multihost_utils
 
-    counts = multihost_utils.process_allgather(
-        np.asarray([len(features)], np.int64)
-    ).ravel()
+    # gather count AND width: a process whose tar shard was empty (or all
+    # undecodable) holds a (0, 0) feature array, and allgather needs
+    # identical shapes across processes
+    meta = multihost_utils.process_allgather(
+        np.asarray([len(features), features.shape[-1]], np.int64)
+    ).reshape(-1, 2)
+    counts, dims = meta[:, 0], meta[:, 1]
     n_max = int(counts.max())
-    pad_f = np.zeros((n_max, features.shape[1]), features.dtype)
-    pad_f[: len(features)] = features
-    pad_y = np.zeros((n_max,), labels.dtype)
+    dim = int(dims.max())
+    pad_f = np.zeros((n_max, dim), np.float32)
+    pad_f[: len(features), : features.shape[-1]] = features
+    pad_y = np.zeros((n_max,), np.int32)
     pad_y[: len(labels)] = labels
     all_f = multihost_utils.process_allgather(pad_f)  # (P, n_max, D)
     all_y = multihost_utils.process_allgather(pad_y)
@@ -233,9 +238,11 @@ def run_streaming(
         conf.num_gmm_samples, conf.seed + 100,
     )
 
-    # ---- pass 1: bounded descriptor-column reservoirs (PCA/GMM) ----
-    res_sift = ColumnReservoir(conf.num_pca_samples, conf.seed)
-    res_lcs = ColumnReservoir(conf.num_pca_samples, conf.seed + 1)
+    # ---- pass 1: bounded descriptor-column reservoirs, sized for the
+    # larger of the PCA and GMM sample budgets ----
+    res_cap = max(conf.num_pca_samples, conf.num_gmm_samples)
+    res_sift = ColumnReservoir(res_cap, conf.seed)
+    res_lcs = ColumnReservoir(res_cap, conf.seed + 1)
     for imgs, _ in train_source():
         res_sift.add(
             _descriptor_cols(apply_in_chunks(sift_fn, imgs, conf.chunk_size))

@@ -126,7 +126,12 @@ def run(conf: MnistRandomFFTConfig, mesh=None) -> dict:
     label_indicators = ClassLabelIndicators(num_classes=NUM_CLASSES)(train_y)
 
     batch_featurizers = build_batch_featurizers(
-        conf.num_ffts, conf.block_size, conf.seed
+        conf.num_ffts,
+        conf.block_size,
+        conf.seed,
+        # width from the data, not the MNIST constant — the reference's
+        # CsvDataLoader accepts any row width (CsvDataLoader.scala:69-82)
+        image_size=train.data.shape[-1],
     )
     t_load = time.perf_counter()
 

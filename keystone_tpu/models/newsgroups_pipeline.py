@@ -42,6 +42,12 @@ class NewsgroupsConfig:
     test_location: str = arg(default="")
     n_grams: int = arg(default=2, help="use 1..n grams")
     common_features: int = arg(default=100_000, help="vocabulary cap")
+    corenlp: bool = arg(
+        default=False,
+        help="featurize with CoreNLPFeatureExtractor (lemmatize + "
+        "entity-type replacement, sentence-bounded n-grams) instead of "
+        "the plain tokenizer chain",
+    )
     synthetic: int = arg(default=0, help="if > 0, N synthetic documents")
 
 
@@ -70,13 +76,20 @@ def run(conf: NewsgroupsConfig, mesh=None) -> dict:
     t0 = time.perf_counter()
     train, test = _load(conf, "train"), _load(conf, "test")
 
-    featurizer_host = (
-        Trim()
-        >> LowerCase()
-        >> Tokenizer()
-        >> NGramsFeaturizer(orders=tuple(range(1, conf.n_grams + 1)))
-        >> TermFrequency(fn=lambda x: 1)
-    )
+    if conf.corenlp:
+        from keystone_tpu.ops.corenlp import CoreNLPFeatureExtractor
+
+        featurizer_host = CoreNLPFeatureExtractor(
+            orders=tuple(range(1, conf.n_grams + 1))
+        ) >> TermFrequency(fn=lambda x: 1)
+    else:
+        featurizer_host = (
+            Trim()
+            >> LowerCase()
+            >> Tokenizer()
+            >> NGramsFeaturizer(orders=tuple(range(1, conf.n_grams + 1)))
+            >> TermFrequency(fn=lambda x: 1)
+        )
     train_tf = featurizer_host(train.data)
     vectorizer = CommonSparseFeatures(conf.common_features).fit(train_tf)
 

@@ -150,6 +150,61 @@ def test_newsgroups_synthetic_end_to_end(mesh8):
     assert res["test_error"] < 0.2
 
 
+def test_newsgroups_corenlp_beats_plain_on_inflected_text(mesh8):
+    """VERDICT round-1 item #8: evaluate the CoreNLP stages' effect on the
+    Newsgroups pipeline. On a corpus where train/test use DIFFERENT
+    inflections of the class vocabulary and person-name noise, the
+    lemmatizing + entity-typing featurizer must generalize at least as
+    well as the plain tokenizer chain."""
+    import numpy as np
+
+    from keystone_tpu.loaders.newsgroups import TextData
+    from keystone_tpu.models import newsgroups_pipeline as ng
+
+    themes = [
+        (["launching", "rockets", "orbiting"], ["launched", "rocket", "orbits"]),
+        (["skating", "scoring", "goals"], ["skated", "scored", "goal"]),
+        (["compiling", "drivers", "crashes"], ["compiled", "driver", "crashed"]),
+        (["riding", "engines", "brakes"], ["rode", "engine", "braked"]),
+    ]
+    names = ["John", "Mary", "David", "Sarah", "Kevin", "Laura"]
+
+    def corpus(which, n, seed):
+        rng = np.random.default_rng(seed)
+        docs, labels = [], []
+        for _ in range(n):
+            k = int(rng.integers(0, len(themes)))
+            vocab = themes[k][0] if which == "train" else themes[k][1]
+            words = list(rng.choice(vocab, size=12)) + list(
+                rng.choice(names, size=4)
+            ) + ["the", "and"]
+            rng.shuffle(words)
+            docs.append(" ".join(words) + ".")
+            labels.append(k)
+        return TextData(labels=np.asarray(labels, np.int32), data=docs)
+
+    datasets = {
+        "train": corpus("train", 80, 0),
+        "test": corpus("test", 40, 1),
+    }
+
+    def run_with(corenlp):
+        conf = ng.NewsgroupsConfig(n_grams=1, corenlp=corenlp, synthetic=1)
+        orig = ng._load
+        ng._load = lambda c, which: datasets[which]
+        try:
+            return ng.run(conf, mesh=mesh8)["test_error"]
+        finally:
+            ng._load = orig
+
+    err_corenlp = run_with(True)
+    err_plain = run_with(False)
+    # plain tokens: train/test vocabularies are disjoint → near-chance;
+    # lemmatized: they collapse to the same lemmas → near-perfect
+    assert err_corenlp <= err_plain
+    assert err_corenlp < 0.2, (err_corenlp, err_plain)
+
+
 def test_timit_synthetic_end_to_end():
     from keystone_tpu.models import timit_pipeline as tp
 
@@ -178,12 +233,62 @@ def test_corenlp_equivalent_extractor():
     out = CoreNLPFeatureExtractor(orders=(1, 2))(
         ["John was running to the stores"]
     )[0]
-    # NER replace (John -> ENTITY), lemmatize (running -> runn? no: run),
-    # lowercase
-    flat = {g for g in out if len(g) == 1}
-    assert ("entity",) in flat
-    assert ("run",) in flat or ("runn",) in flat
-    assert ("store",) in flat
+    # NER types the name (John -> PERSON), lemmatizer resolves running ->
+    # run and stores -> store, was -> be; bigrams are space-joined like
+    # the reference's mkString(" ")
+    assert "PERSON" in out
+    assert "run" in out and "store" in out and "be" in out
+    assert "PERSON be" in out
+
+
+def test_corenlp_lemmatizer_rules():
+    from keystone_tpu.ops.corenlp import default_lemmatize
+
+    cases = {
+        "running": "run",        # consonant undoubling
+        "making": "make",        # e-restoration
+        "studies": "study",      # ies -> y
+        "children": "child",     # irregular plural
+        "went": "go",            # irregular verb
+        "better": "good",        # comparative exception
+        "boxes": "box",          # xes -> x
+        "knives": "knife",       # irregular ves
+        "talked": "talk",
+        "cities": "city",
+    }
+    for word, lemma in cases.items():
+        assert default_lemmatize(word) == lemma, (word, default_lemmatize(word))
+
+
+def test_corenlp_ner_types():
+    from keystone_tpu.ops.corenlp import split_sentences, tag_entities
+
+    toks = split_sentences(
+        "Dr. Smith met Mary in Paris on Monday 1995 with IBM and "
+        "Acme Corp paying 450 dollars."
+    )[0]
+    tags = dict(zip(toks, tag_entities(toks)))
+    assert tags["Smith"] == "PERSON"
+    assert tags["Mary"] == "PERSON"
+    assert tags["Paris"] == "LOCATION"
+    assert tags["Monday"] == "DATE"
+    assert tags["1995"] == "DATE"
+    assert tags["IBM"] == "ORGANIZATION"
+    assert tags["Acme"] == "ORGANIZATION" and tags["Corp"] == "ORGANIZATION"
+    assert tags["450"] == "NUMBER"
+    assert tags["dollars"] == "O"
+
+
+def test_corenlp_sentence_boundaries():
+    from keystone_tpu.ops.corenlp import CoreNLPFeatureExtractor
+
+    out = CoreNLPFeatureExtractor(orders=(2,))(
+        ["The cat sat. The dog ran."]
+    )[0]
+    # no bigram spans the sentence boundary (reference: n-grams respect
+    # sentence boundaries)
+    assert "sat the" not in out and "sat dog" not in out
+    assert "the cat" in out and "the dog" in out
 
 
 def test_stats_helpers():

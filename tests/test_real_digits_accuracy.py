@@ -1,0 +1,56 @@
+"""Real-data accuracy parity point (VERDICT round-1 item #7).
+
+The reference's headline MNIST capability (MnistRandomFFT.scala:20-88)
+cannot be reproduced bit-for-bit offline — the MNIST corpus is not
+obtainable in this zero-egress environment — so the gate runs the SAME
+pipeline, via the same CLI surface and CSV format, on the closest real
+handwritten-digit data available locally: sklearn's ``load_digits``
+(1,797 real 8×8 digit images from the same NIST source family). The
+resulting test error is recorded in PARITY.md.
+"""
+
+import numpy as np
+import pytest
+
+sklearn_datasets = pytest.importorskip("sklearn.datasets")
+
+
+@pytest.fixture(scope="module")
+def digit_csvs(tmp_path_factory):
+    d = sklearn_datasets.load_digits()
+    rng = np.random.default_rng(0)
+    order = rng.permutation(len(d.target))
+    data, target = d.data[order], d.target[order]
+    n_train = 1300
+    root = tmp_path_factory.mktemp("digits")
+
+    def write(path, x, y):
+        # reference MNIST CSV format: 1-indexed label first, then pixels
+        rows = np.concatenate([(y + 1)[:, None], x], axis=1)
+        np.savetxt(path, rows, fmt="%.4f", delimiter=",")
+
+    write(root / "train.csv", data[:n_train], target[:n_train])
+    write(root / "test.csv", data[n_train:], target[n_train:])
+    return str(root / "train.csv"), str(root / "test.csv"), len(target) - n_train
+
+
+def test_random_fft_real_digits_accuracy(digit_csvs):
+    from keystone_tpu.models import mnist_random_fft as m
+
+    train_csv, test_csv, n_test = digit_csvs
+    res = m.main(
+        [
+            "--train-location", train_csv,
+            "--test-location", test_csv,
+            "--num-ffts", "16",
+            "--block-size", "512",
+            "--lam", "0.1",
+            "--seed", "0",
+        ]
+    )
+    assert res["n_test"] == n_test
+    # linear model over random-FFT features on real digits: the reference
+    # pipeline family sits well under 10% error here; gate generously so
+    # the test pins capability, not noise
+    assert res["test_error"] < 0.10, res
+    print(f"real-digits test error: {res['test_error']:.4f}")
