@@ -99,13 +99,37 @@ class PaddedFFT(Transformer):
     """Zero-pad each row to the next power of two, FFT, return the real part
     of the first half (nodes/stats/PaddedFFT.scala).
 
-    Output dim for input dim d: ``next_pow2(d) // 2``. Uses ``rfft`` (the
-    real part of the first half of a full FFT equals ``Re(rfft)[:n/2]``).
+    Output dim for input dim d: ``next_pow2(d) // 2``. Two backends:
+
+    - ``fft``: ``Re(rfft)[:n/2]`` — best on CPU (O(n log n) butterflies).
+    - ``matmul``: the same values as one cosine-matrix gemm,
+      ``x @ cos(2π k n / N)`` — only the needed half-spectrum's real part
+      is ever computed, the zero padding never materializes, and the work
+      lands on the MXU where it fuses with neighboring ops. On v5e this
+      is ~5x faster than XLA's FFT lowering at MNIST shapes (the
+      featurize stage dominated the round-2 bench before this).
+    - ``auto`` (default): matmul on TPU, fft elsewhere.
     """
+
+    impl: str = static_field(default="auto")
 
     def __call__(self, batch):
         d = batch.shape[-1]
         n = 1 << max(int(np.ceil(np.log2(d))), 0) if d > 1 else 1
+        impl = self.impl
+        if impl == "auto":
+            from keystone_tpu.ops.flash_attention import on_tpu
+
+            impl = "matmul" if on_tpu() else "fft"
+        if impl == "matmul":
+            # real part of rfft of the zero-padded row: pad columns drop
+            # out of the sum, so the matrix is only (d, n/2)
+            k = np.arange(n // 2)[None, :]
+            nn = np.arange(d)[:, None]
+            cos = jnp.asarray(
+                np.cos(2.0 * np.pi * k * nn / n), batch.dtype
+            )
+            return batch @ cos
         padded = jnp.pad(batch, [(0, 0)] * (batch.ndim - 1) + [(0, n - d)])
         return jnp.real(jnp.fft.rfft(padded, axis=-1))[..., : n // 2]
 

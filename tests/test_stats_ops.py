@@ -78,6 +78,15 @@ def test_padded_fft_matches_numpy(rng):
     np.testing.assert_allclose(out, ref, atol=1e-3)
 
 
+def test_padded_fft_matmul_impl_matches_fft(rng):
+    """The MXU cosine-gemm backend must produce the FFT path's values."""
+    for d in (50, 64, 784):
+        x = rng.normal(size=(4, d)).astype(np.float32)
+        a = np.asarray(PaddedFFT(impl="fft")(jnp.asarray(x)))
+        b = np.asarray(PaddedFFT(impl="matmul")(jnp.asarray(x)))
+        np.testing.assert_allclose(a, b, atol=2e-3)
+
+
 def test_linear_rectifier():
     x = jnp.asarray([[-2.0, 0.5, 3.0]])
     out = np.asarray(LinearRectifier(max_val=0.0, alpha=1.0)(x))
