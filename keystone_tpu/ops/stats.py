@@ -10,6 +10,8 @@ from __future__ import annotations
 
 from typing import Callable
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -94,6 +96,16 @@ class RandomSignNode(Transformer):
         return RandomSignNode(signs=signs)
 
 
+@functools.lru_cache(maxsize=32)
+def _cos_matrix(d: int, n: int, dtype: str):
+    """Cached (d, n/2) half-spectrum cosine matrix for PaddedFFT's matmul
+    backend: real part of rfft of the zero-padded row — pad columns drop
+    out of the sum, so only the d live rows exist."""
+    k = np.arange(n // 2)[None, :]
+    nn = np.arange(d)[:, None]
+    return jnp.asarray(np.cos(2.0 * np.pi * k * nn / n), dtype)
+
+
 @treenode
 class PaddedFFT(Transformer):
     """Zero-pad each row to the next power of two, FFT, return the real part
@@ -114,6 +126,10 @@ class PaddedFFT(Transformer):
     impl: str = static_field(default="auto")
 
     def __call__(self, batch):
+        if self.impl not in ("auto", "fft", "matmul"):
+            raise ValueError(
+                f"PaddedFFT impl={self.impl!r}; expected auto|fft|matmul"
+            )
         d = batch.shape[-1]
         n = 1 << max(int(np.ceil(np.log2(d))), 0) if d > 1 else 1
         impl = self.impl
@@ -122,14 +138,7 @@ class PaddedFFT(Transformer):
 
             impl = "matmul" if on_tpu() else "fft"
         if impl == "matmul":
-            # real part of rfft of the zero-padded row: pad columns drop
-            # out of the sum, so the matrix is only (d, n/2)
-            k = np.arange(n // 2)[None, :]
-            nn = np.arange(d)[:, None]
-            cos = jnp.asarray(
-                np.cos(2.0 * np.pi * k * nn / n), batch.dtype
-            )
-            return batch @ cos
+            return batch @ _cos_matrix(d, n, str(batch.dtype))
         padded = jnp.pad(batch, [(0, 0)] * (batch.ndim - 1) + [(0, n - d)])
         return jnp.real(jnp.fft.rfft(padded, axis=-1))[..., : n // 2]
 
