@@ -225,8 +225,8 @@ def flash_attention(
     v,
     *,
     causal: bool = False,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 512,
     q_offset=0,
     k_offset=0,
     mxu_dtype=None,
@@ -255,8 +255,11 @@ def flash_attention(
     scale = 1.0 / math.sqrt(d)
     out_dtype = q.dtype
 
-    block_q = min(block_q, max(s_q, 8))
-    block_k = min(block_k, max(s_k, 8))
+    # clamp to the sequence, rounded UP to a multiple of 8: Mosaic needs
+    # 8-aligned f32 sublane tiles, and a short unaligned sequence (e.g.
+    # ViT's 196 patches) would otherwise become the block shape itself
+    block_q = -(-min(block_q, max(s_q, 8)) // 8) * 8
+    block_k = -(-min(block_k, max(s_k, 8)) // 8) * 8
 
     if mxu_dtype is not None:
         # cast on the XLA side: halves the K/V HBM→VMEM stream for bf16
@@ -417,7 +420,7 @@ def flash_attention_step(
     q_offset,
     k_offset,
     causal: bool = False,
-    block_q: int = 128,
+    block_q: int = 512,
     block_k: int = 128,
     padded_state: bool = False,
     interpret: bool | None = None,
@@ -442,8 +445,8 @@ def flash_attention_step(
     b, h, s_q, d = q.shape
     s_k = k_blk.shape[2]
     scale = 1.0 / math.sqrt(d)
-    block_q = min(block_q, max(s_q, 8))
-    block_k = min(block_k, max(s_k, 8))
+    block_q = -(-min(block_q, max(s_q, 8)) // 8) * 8
+    block_k = -(-min(block_k, max(s_k, 8)) // 8) * 8
 
     qf = _pad_to(q.reshape(b * h, s_q, d), 1, block_q)
     kf = _pad_to(k_blk.reshape(b * h, s_k, d), 1, block_k)

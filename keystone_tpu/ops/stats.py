@@ -97,13 +97,18 @@ class RandomSignNode(Transformer):
 
 
 @functools.lru_cache(maxsize=32)
-def _cos_matrix(d: int, n: int, dtype: str):
-    """Cached (d, n/2) half-spectrum cosine matrix for PaddedFFT's matmul
-    backend: real part of rfft of the zero-padded row — pad columns drop
-    out of the sum, so only the d live rows exist."""
+def _cos_matrix_host(d: int, n: int):
+    """Cached HOST (d, n/2) half-spectrum cosine matrix for PaddedFFT's
+    matmul backend: real part of rfft of the zero-padded row — pad columns
+    drop out of the sum, so only the d live rows exist. Cached as numpy so
+    repeat eager calls skip the trig, without pinning device buffers."""
     k = np.arange(n // 2)[None, :]
     nn = np.arange(d)[:, None]
-    return jnp.asarray(np.cos(2.0 * np.pi * k * nn / n), dtype)
+    return np.cos(2.0 * np.pi * k * nn / n)
+
+
+def _cos_matrix(d: int, n: int, dtype: str):
+    return jnp.asarray(_cos_matrix_host(d, n), dtype)
 
 
 @treenode
