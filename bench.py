@@ -284,6 +284,10 @@ def main() -> None:
         jax.config.update("jax_platforms", "cpu")
         N_TRAIN = 12_000
         CIFAR_N = 512
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from keystone_tpu.core.runtime import enable_compilation_cache
+
+    enable_compilation_cache()
     labels, data = _synthetic(N_TRAIN)
     mnist = bench_mnist(labels, data)
     cifar = bench_cifar_conv()

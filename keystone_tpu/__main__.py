@@ -66,6 +66,9 @@ def main(argv: list[str] | None = None) -> None:
             f" jax.distributed runtime before dispatch — run the same command"
             f" on every host)"
         )
+    from keystone_tpu.core.runtime import enable_compilation_cache
+
+    enable_compilation_cache()
     if multihost:
         from keystone_tpu.parallel import multihost as mh
 

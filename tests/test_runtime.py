@@ -1,0 +1,42 @@
+"""Process-level runtime setup (persistent compilation cache)."""
+
+import os
+
+import jax
+import pytest
+
+from keystone_tpu.core.runtime import enable_compilation_cache
+
+
+@pytest.fixture(autouse=True)
+def _restore_jax_cache_config():
+    """The helper mutates global jax config; keep it test-local."""
+    before = (
+        jax.config.jax_compilation_cache_dir,
+        jax.config.jax_persistent_cache_min_compile_time_secs,
+    )
+    yield
+    jax.config.update("jax_compilation_cache_dir", before[0])
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", before[1])
+
+
+def test_cache_dir_created_and_configured(tmp_path):
+    d = str(tmp_path / "xla-cache")
+    out = enable_compilation_cache(d)
+    assert out == d and os.path.isdir(d)
+    assert jax.config.jax_compilation_cache_dir == d
+
+
+def test_cache_env_override(tmp_path, monkeypatch):
+    d = str(tmp_path / "env-cache")
+    monkeypatch.setenv("KEYSTONE_XLA_CACHE", d)
+    assert enable_compilation_cache() == d
+
+
+def test_cache_disabled_by_empty_env(monkeypatch):
+    monkeypatch.setenv("KEYSTONE_XLA_CACHE", "")
+    assert enable_compilation_cache() is None
+
+
+def test_cache_uncreatable_dir_is_best_effort():
+    assert enable_compilation_cache("/proc/definitely/not/writable") is None
