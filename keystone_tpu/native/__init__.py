@@ -75,8 +75,40 @@ def get_lib() -> ctypes.CDLL | None:
             ctypes.c_int64,
         ]
         lib.cifar_read.restype = ctypes.c_int64
+        if not _bind_dsift(lib):
+            # stale prebuilt library without the dsift symbols: rebuild
+            # once and reload; if that fails, keep the IO symbols and
+            # let native_dsift degrade to None
+            if _build():
+                try:
+                    lib = ctypes.CDLL(_LIB_PATH)
+                except OSError:
+                    _lib = None
+                    return None
+                _bind_dsift(lib)
         _lib = lib
         return _lib
+
+
+def _bind_dsift(lib: ctypes.CDLL) -> bool:
+    try:
+        lib.dsift_descriptor_count.argtypes = [ctypes.c_int] * 6
+        lib.dsift_descriptor_count.restype = ctypes.c_int
+        lib.dsift_flat_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int16),
+        ]
+        lib.dsift_flat_batch.restype = ctypes.c_int
+    except AttributeError:
+        return False
+    return True
 
 
 def native_load_csv(path: str) -> np.ndarray | None:
@@ -99,6 +131,47 @@ def native_load_csv(path: str) -> np.ndarray | None:
         logger.info("native csv parse failed (rc=%d) for %s", rc, path)
         return None
     return out
+
+
+def native_dsift(
+    images: np.ndarray,
+    *,
+    step: int = 3,
+    bin_size: int = 4,
+    num_scales: int = 5,
+    scale_step: int = 0,
+) -> np.ndarray | None:
+    """Host dense SIFT (``native/dsift.cpp`` — the VLFeat-shim parity
+    fallback; same flat-window algorithm and output layout as the
+    on-device ``ops.sift.SIFTExtractor``).
+
+    images: (N, H, W) grayscale in [0, 1] → (N, 128, M) float32, or None
+    when the native library is unavailable (caller falls back).
+    """
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "dsift_flat_batch"):
+        return None
+    images = np.ascontiguousarray(images, np.float32)
+    n, h, w = images.shape
+    count = lib.dsift_descriptor_count(
+        h, w, step, bin_size, num_scales, scale_step
+    )
+    out = np.empty((n, count, 128), np.int16)
+    got = lib.dsift_flat_batch(
+        images.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        n,
+        h,
+        w,
+        step,
+        bin_size,
+        num_scales,
+        scale_step,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int16)),
+    )
+    if got != count:
+        logger.info("native dsift count mismatch: %d != %d", got, count)
+        return None
+    return np.transpose(out, (0, 2, 1)).astype(np.float32)
 
 
 def native_load_cifar(path: str) -> tuple[np.ndarray, np.ndarray] | None:

@@ -206,10 +206,32 @@ class SIFTExtractor(Transformer):
     bin_size: int = static_field(default=4)
     num_scales: int = static_field(default=5)
     scale_step: int = static_field(default=0)
+    # "device": one jitted XLA program (default). "native": the C++ host
+    # kernel (native/dsift.cpp via ctypes) — the VLFeat-shim parity
+    # fallback, same algorithm and layout, for hosts without a usable
+    # accelerator; falls back to device if the library won't build.
+    backend: str = static_field(default="device")
 
     def __call__(self, batch):
         if batch.ndim == 4:
             batch = batch[..., 0]
+        if self.backend == "native":
+            from keystone_tpu.native import native_dsift
+
+            out = native_dsift(
+                np.asarray(batch),
+                step=self.step,
+                bin_size=self.bin_size,
+                num_scales=self.num_scales,
+                scale_step=self.scale_step,
+            )
+            if out is not None:
+                return jnp.asarray(out)
+        elif self.backend != "device":
+            raise ValueError(
+                f"SIFTExtractor backend={self.backend!r}; "
+                "expected device|native"
+            )
         return _sift_multiscale(
             batch, self.step, self.bin_size, self.num_scales, self.scale_step
         )

@@ -1,0 +1,237 @@
+// Native host dense-SIFT: the VLFeat-shim parity fallback.
+//
+// Implements the same flat-window vl_dsift algorithm as the on-device
+// path (keystone_tpu/ops/sift.py — see its docstring for the stage list
+// and the reference citations into src/main/cpp/VLFeat.cxx), in C++;
+// dsift_flat_batch parallelizes over images with OpenMP.
+// This is the moral successor of the reference's
+// libImageFeatures JNI shim: a host kernel for machines where the
+// on-device path is unavailable, and an independent cross-check of it.
+// Re-derived from the algorithm, no VLFeat code vendored.
+//
+// Exposed via ctypes (see keystone_tpu/native/__init__.py):
+//   dsift_descriptor_count(h, w, step, bin, num_scales, scale_step)
+//   dsift_flat(img[h*w] row-major grayscale 0..1, h, w, step, bin,
+//              num_scales, scale_step, out[count*128] int16)
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr int kNumT = 8;       // orientation bins
+constexpr int kNumB = 4;       // spatial bins per axis
+constexpr int kDesc = 128;     // kNumT * kNumB * kNumB
+constexpr double kWindow = 1.5;
+constexpr double kMagnif = 6.0;
+constexpr double kContrast = 0.005;
+
+inline int clampi(int v, int lo, int hi) {
+  return v < lo ? lo : (v > hi ? hi : v);
+}
+
+// gaussian smoothing, radius ceil(4*sigma), edge clamped, separable
+void smooth(const float* img, int h, int w, double sigma, float* out,
+            float* tmp) {
+  int radius = (int)std::ceil(4.0 * sigma);
+  if (radius < 1) radius = 1;
+  std::vector<double> k(2 * radius + 1);
+  double sum = 0.0;
+  for (int i = -radius; i <= radius; ++i) {
+    k[i + radius] = std::exp(-0.5 * (i / sigma) * (i / sigma));
+    sum += k[i + radius];
+  }
+  for (auto& v : k) v /= sum;
+  for (int r = 0; r < h; ++r)
+    for (int c = 0; c < w; ++c) {
+      double acc = 0.0;
+      for (int i = -radius; i <= radius; ++i)
+        acc += k[i + radius] * img[r * w + clampi(c + i, 0, w - 1)];
+      tmp[r * w + c] = (float)acc;
+    }
+  for (int r = 0; r < h; ++r)
+    for (int c = 0; c < w; ++c) {
+      double acc = 0.0;
+      for (int i = -radius; i <= radius; ++i)
+        acc += k[i + radius] * tmp[clampi(r + i, 0, h - 1) * w + c];
+      out[r * w + c] = (float)acc;
+    }
+}
+
+// soft-binned orientation planes; angle atan2(-gx, gy) (the shim's net
+// transpose convention), gradients one-sided at borders
+void orientation_planes(const float* img, int h, int w, float* planes) {
+  std::memset(planes, 0, sizeof(float) * h * w * kNumT);
+  for (int r = 0; r < h; ++r)
+    for (int c = 0; c < w; ++c) {
+      float gy = (r == 0)       ? img[w + c] - img[c]
+                 : (r == h - 1) ? img[r * w + c] - img[(r - 1) * w + c]
+                                : 0.5f * (img[(r + 1) * w + c] -
+                                          img[(r - 1) * w + c]);
+      float gx = (c == 0)       ? img[r * w + 1] - img[r * w]
+                 : (c == w - 1) ? img[r * w + c] - img[r * w + c - 1]
+                                : 0.5f * (img[r * w + c + 1] -
+                                          img[r * w + c - 1]);
+      float mag = std::sqrt(gx * gx + gy * gy);
+      double angle = std::atan2(-(double)gx, (double)gy);
+      double nt = angle * (kNumT / (2.0 * M_PI));
+      nt = std::fmod(nt, (double)kNumT);
+      if (nt < 0) nt += kNumT;
+      int lo = (int)std::floor(nt) % kNumT;
+      double frac = nt - std::floor(nt);
+      planes[(r * w + c) * kNumT + lo] += mag * (float)(1.0 - frac);
+      planes[(r * w + c) * kNumT + (lo + 1) % kNumT] += mag * (float)frac;
+    }
+}
+
+// unit-integral triangular convolution of the planes, edge clamped
+void tri_convolve(const float* planes, int h, int w, int bin, float* out,
+                  float* tmp) {
+  int half = bin - 1;
+  double inv = 1.0 / ((double)bin * bin);
+  // rows
+  for (int r = 0; r < h; ++r)
+    for (int c = 0; c < w; ++c)
+      for (int t = 0; t < kNumT; ++t) {
+        double acc = 0.0;
+        for (int u = -half; u <= half; ++u) {
+          int cc = clampi(c + u, 0, w - 1);
+          acc += (bin - std::abs(u)) * inv *
+                 planes[(r * w + cc) * kNumT + t];
+        }
+        tmp[(r * w + c) * kNumT + t] = (float)acc;
+      }
+  // cols
+  for (int r = 0; r < h; ++r)
+    for (int c = 0; c < w; ++c)
+      for (int t = 0; t < kNumT; ++t) {
+        double acc = 0.0;
+        for (int u = -half; u <= half; ++u) {
+          int rr = clampi(r + u, 0, h - 1);
+          acc += (bin - std::abs(u)) * inv *
+                 tmp[(rr * w + c) * kNumT + t];
+        }
+        out[(r * w + c) * kNumT + t] = (float)acc;
+      }
+}
+
+double bin_window_mean(int bin, int idx) {
+  double delta = bin * (idx - 0.5 * (kNumB - 1));
+  double sigma = (double)bin * kWindow;
+  double acc = 0.0;
+  int n = 0;
+  for (int x = -bin + 1; x <= bin - 1; ++x, ++n) {
+    double z = (x - delta) / sigma;
+    acc += std::exp(-0.5 * z * z);
+  }
+  return acc / n;
+}
+
+int grid_len(int dim, int off, int frame, int st) {
+  int last = dim - frame;  // inclusive max corner
+  if (last < off) return 0;
+  return (last - off) / st + 1;
+}
+
+}  // namespace
+
+extern "C" {
+
+// total descriptors across scales for an (h, w) image
+int dsift_descriptor_count(int h, int w, int step, int bin, int num_scales,
+                           int scale_step) {
+  int total = 0;
+  for (int s = 0; s < num_scales; ++s) {
+    int b = bin + 2 * s;
+    int off = (1 + 2 * num_scales) - 3 * s;
+    if (off < 0) off = 0;
+    int frame = (kNumB - 1) * b + 1;
+    int st = step + s * scale_step;
+    total += grid_len(h, off, frame, st) * grid_len(w, off, frame, st);
+  }
+  return total;
+}
+
+// out: int16[count * 128], descriptors ordered (scale, col-outer,
+// row-inner), entries (row-bin, col-bin, orientation) — identical to the
+// on-device SIFTExtractor layout
+int dsift_flat(const float* img, int h, int w, int step, int bin,
+               int num_scales, int scale_step, int16_t* out);
+
+// batch entry point: OpenMP over images (each image's scratch buffers
+// are thread-local inside dsift_flat)
+int dsift_flat_batch(const float* imgs, int n, int h, int w, int step,
+                     int bin, int num_scales, int scale_step,
+                     int16_t* out) {
+  int count = dsift_descriptor_count(h, w, step, bin, num_scales,
+                                     scale_step);
+#pragma omp parallel for schedule(dynamic)
+  for (int i = 0; i < n; ++i) {
+    dsift_flat(imgs + (size_t)i * h * w, h, w, step, bin, num_scales,
+               scale_step, out + (size_t)i * count * kDesc);
+  }
+  return count;
+}
+
+int dsift_flat(const float* img, int h, int w, int step, int bin,
+               int num_scales, int scale_step, int16_t* out) {
+  std::vector<float> smoothed(h * w), tmp(h * w);
+  std::vector<float> planes(h * w * kNumT), conv(h * w * kNumT),
+      ptmp(h * w * kNumT);
+  int written = 0;
+  for (int s = 0; s < num_scales; ++s) {
+    int b = bin + 2 * s;
+    smooth(img, h, w, b / kMagnif, smoothed.data(), tmp.data());
+    orientation_planes(smoothed.data(), h, w, planes.data());
+    tri_convolve(planes.data(), h, w, b, conv.data(), ptmp.data());
+
+    double wmean[kNumB];
+    for (int i = 0; i < kNumB; ++i) wmean[i] = bin_window_mean(b, i) * b;
+
+    int off = (1 + 2 * num_scales) - 3 * s;
+    if (off < 0) off = 0;
+    int frame = (kNumB - 1) * b + 1;
+    int st = step + s * scale_step;
+    for (int c0 = off; c0 <= w - frame; c0 += st)
+      for (int r0 = off; r0 <= h - frame; r0 += st) {
+        double desc[kDesc];
+        for (int i = 0; i < kNumB; ++i)
+          for (int j = 0; j < kNumB; ++j) {
+            const float* cell = &conv[((r0 + i * b) * w + (c0 + j * b)) *
+                                      kNumT];
+            double scale_w = wmean[i] * wmean[j];
+            for (int t = 0; t < kNumT; ++t)
+              desc[(i * kNumB + j) * kNumT + t] = cell[t] * scale_w;
+          }
+        // finalize: L2 -> clamp 0.2 -> re-L2 -> trunc(512 v) cap 255;
+        // zero when the pre-normalization norm is under the threshold
+        double norm = 0.0;
+        for (double v : desc) norm += v * v;
+        norm = std::sqrt(norm);
+        int16_t* dst = out + (size_t)written * kDesc;
+        if (norm < kContrast) {
+          std::memset(dst, 0, sizeof(int16_t) * kDesc);
+        } else {
+          double n1 = norm > 1e-10 ? norm : 1e-10;
+          double renorm = 0.0;
+          for (int d = 0; d < kDesc; ++d) {
+            desc[d] = desc[d] / n1;
+            if (desc[d] > 0.2) desc[d] = 0.2;
+            renorm += desc[d] * desc[d];
+          }
+          renorm = std::sqrt(renorm);
+          if (renorm < 1e-10) renorm = 1e-10;
+          for (int d = 0; d < kDesc; ++d) {
+            int v = (int)(512.0 * desc[d] / renorm);
+            dst[d] = (int16_t)(v < 255 ? v : 255);
+          }
+        }
+        ++written;
+      }
+  }
+  return written;
+}
+
+}  // extern "C"
