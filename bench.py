@@ -273,7 +273,10 @@ def main() -> None:
 
     global N_TRAIN, CIFAR_N
 
-    fallback = not _accelerator_alive()
+    # a cpu-pinned environment (e.g. the mid-run-failure rerun child)
+    # cannot have an accelerator: skip the multi-attempt probe entirely
+    cpu_pinned = os.environ.get("JAX_PLATFORMS", "").split(",")[0] == "cpu"
+    fallback = cpu_pinned or not _accelerator_alive()
     if fallback:
         # run the same jax program on the host CPU and say so — an honest
         # degraded measurement beats a hung driver. Scale the workloads
@@ -289,8 +292,44 @@ def main() -> None:
 
     enable_compilation_cache()
     labels, data = _synthetic(N_TRAIN)
-    mnist = bench_mnist(labels, data)
-    cifar = bench_cifar_conv()
+    try:
+        mnist = bench_mnist(labels, data)
+        cifar = bench_cifar_conv()
+    except Exception as e:  # noqa: BLE001 — tunnel died mid-run
+        if fallback:
+            raise
+        # the probe passed but the accelerator failed during the run (the
+        # axon tunnel can drop mid-session): rerun the whole bench on the
+        # host CPU in a fresh subprocess so the driver still gets a line
+        print(
+            f"# accelerator failed mid-bench ({type(e).__name__}); "
+            "rerunning on CPU",
+            file=sys.stderr,
+        )
+        import subprocess
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env,
+                capture_output=True,
+                text=True,
+                timeout=1200,
+            )
+        except subprocess.TimeoutExpired:
+            print("# CPU rerun timed out after 1200s", file=sys.stderr)
+            raise e from None
+        line = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
+        if out.returncode != 0 or not line:
+            print(
+                "# CPU rerun failed "
+                f"(rc={out.returncode}): {out.stderr.strip()[-500:]}",
+                file=sys.stderr,
+            )
+            raise
+        print(line)
+        return
     cpu_rate = bench_cpu_numpy(labels[:CPU_SUBSET], data[:CPU_SUBSET], N_TRAIN)
     cpu_cifar = bench_cpu_cifar_conv()
     metric = "mnist_random_fft featurize+fit samples/sec"

@@ -55,39 +55,45 @@ def get_lib() -> ctypes.CDLL | None:
         except OSError as e:
             logger.info("failed to load %s: %s", _LIB_PATH, e)
             return None
-        lib.csv_dims.argtypes = [
-            ctypes.c_char_p,
-            ctypes.POINTER(ctypes.c_int64),
-            ctypes.POINTER(ctypes.c_int64),
-        ]
-        lib.csv_dims.restype = ctypes.c_int
-        lib.csv_read.argtypes = [
-            ctypes.c_char_p,
-            ctypes.POINTER(ctypes.c_float),
-            ctypes.c_int64,
-            ctypes.c_int64,
-        ]
-        lib.csv_read.restype = ctypes.c_int
-        lib.cifar_read.argtypes = [
-            ctypes.c_char_p,
-            ctypes.POINTER(ctypes.c_int32),
-            ctypes.POINTER(ctypes.c_float),
-            ctypes.c_int64,
-        ]
-        lib.cifar_read.restype = ctypes.c_int64
+        _bind_io(lib)
         if not _bind_dsift(lib):
             # stale prebuilt library without the dsift symbols: rebuild
-            # once and reload; if that fails, keep the IO symbols and
-            # let native_dsift degrade to None
+            # once and reload (re-binding EVERY symbol on the fresh
+            # handle); if that fails, keep the IO symbols and let
+            # native_dsift degrade to None
             if _build():
                 try:
                     lib = ctypes.CDLL(_LIB_PATH)
                 except OSError:
                     _lib = None
                     return None
+                _bind_io(lib)
                 _bind_dsift(lib)
         _lib = lib
         return _lib
+
+
+def _bind_io(lib: ctypes.CDLL) -> None:
+    lib.csv_dims.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.csv_dims.restype = ctypes.c_int
+    lib.csv_read.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int64,
+        ctypes.c_int64,
+    ]
+    lib.csv_read.restype = ctypes.c_int
+    lib.cifar_read.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int64,
+    ]
+    lib.cifar_read.restype = ctypes.c_int64
 
 
 def _bind_dsift(lib: ctypes.CDLL) -> bool:
@@ -148,6 +154,12 @@ def native_dsift(
     images: (N, H, W) grayscale in [0, 1] → (N, 128, M) float32, or None
     when the native library is unavailable (caller falls back).
     """
+    if step < 1 or bin_size < 1 or num_scales < 1:
+        raise ValueError("dsift needs step >= 1, bin_size >= 1, num_scales >= 1")
+    if any(step + s * scale_step < 1 for s in range(num_scales)):
+        raise ValueError(
+            f"scale_step={scale_step} drives the per-scale step below 1"
+        )
     lib = get_lib()
     if lib is None or not hasattr(lib, "dsift_flat_batch"):
         return None

@@ -216,6 +216,12 @@ class SIFTExtractor(Transformer):
         if batch.ndim == 4:
             batch = batch[..., 0]
         if self.backend == "native":
+            if isinstance(batch, jax.core.Tracer):
+                raise TypeError(
+                    "SIFTExtractor(backend='native') is a host-only path "
+                    "and cannot run under jit; use the default device "
+                    "backend inside jitted pipelines"
+                )
             from keystone_tpu.native import native_dsift
 
             out = native_dsift(
