@@ -56,10 +56,18 @@ def main(argv: list[str] | None = None) -> None:
     multihost = "--multihost" in argv
     if multihost:
         argv.remove("--multihost")
+    profile_dir = None
+    if "--profile" in argv:
+        i = argv.index("--profile")
+        if i + 1 >= len(argv):
+            raise SystemExit("--profile needs a trace directory argument")
+        profile_dir = argv[i + 1]
+        del argv[i : i + 2]
     if not argv or argv[0] in ("-h", "--help"):
         names = "\n  ".join(sorted(PIPELINES))
         raise SystemExit(
-            f"usage: python -m keystone_tpu [--multihost] <pipeline> [args...]\n"
+            f"usage: python -m keystone_tpu [--multihost] "
+            f"[--profile DIR] <pipeline> [args...]\n"
             f"pipelines:\n  {names}\n"
             f"(reference class names like pipelines.images.mnist.MnistRandomFFT"
             f" are also accepted; --multihost joins this process into the\n"
@@ -86,7 +94,14 @@ def main(argv: list[str] | None = None) -> None:
         raise SystemExit(f"unknown pipeline {name!r}; run with --help for a list")
     import importlib
 
-    importlib.import_module(target).main(rest)
+    entry = importlib.import_module(target).main
+    if profile_dir is not None:
+        from keystone_tpu.core.profiling import trace
+
+        with trace(profile_dir):
+            entry(rest)
+    else:
+        entry(rest)
 
 
 if __name__ == "__main__":
