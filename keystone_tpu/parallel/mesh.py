@@ -48,6 +48,14 @@ def create_mesh(
     ``data=None`` uses all remaining devices on the data axis. A v5p pod
     slice's ICI torus is contiguous in ``jax.devices()`` order, so adjacent
     mesh slots get adjacent chips and collectives ride ICI.
+
+    Multi-slice environments (devices reporting distinct ``slice_index``)
+    get a HYBRID mesh: the slice dimension lands on the OUTER part of the
+    "data" axis so data-parallel Gram/gradient reductions cross DCN only
+    at the top of the reduction tree, while "model"-axis collectives stay
+    entirely within one slice's ICI — the moral successor of the
+    reference's ``spark.mlmatrix.treeBranchingFactor`` hierarchy control
+    (``BlockWeightedLeastSquares.scala:186-188``).
     """
     devs = list(devices if devices is not None else jax.devices())
     if model < 1:
@@ -59,8 +67,27 @@ def create_mesh(
     n = data * model
     if n > len(devs):
         raise ValueError(f"mesh {data}x{model} needs {n} devices, have {len(devs)}")
+    n_slices = len(_slice_groups(devs[:n]))
+    if n_slices > 1 and data % n_slices == 0 and n == len(devs):
+        from jax.experimental import mesh_utils
+
+        grid = mesh_utils.create_hybrid_device_mesh(
+            (data // n_slices, model),
+            (n_slices, 1),  # DCN spans the data axis only
+            devices=devs,
+        )
+        return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
     grid = np.asarray(devs[:n]).reshape(data, model)
     return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def _slice_groups(devs: Sequence) -> dict:
+    """Group devices by their DCN slice (``slice_index``); single-slice and
+    CPU devices (no attribute) collapse to one group."""
+    groups: dict = {}
+    for d in devs:
+        groups.setdefault(getattr(d, "slice_index", 0), []).append(d)
+    return groups
 
 
 @contextlib.contextmanager

@@ -77,3 +77,23 @@ def test_gpipe_validates_stage_count(pp_mesh, rng):
     x = jnp.zeros((4, 2, 8), jnp.float32)
     with pytest.raises(ValueError, match="stages"):
         gpipe(_stage_fn, params, x, pp_mesh, axis="model")
+
+
+def test_mesh_slice_grouping_single_and_multi(devices):
+    """Hybrid-mesh slice detection: CPU/virtual devices collapse to one
+    group (plain mesh); stub multi-slice devices split by slice_index."""
+    from keystone_tpu.parallel.mesh import _slice_groups, create_mesh
+
+    assert len(_slice_groups(devices)) == 1
+
+    class FakeDev:
+        def __init__(self, s):
+            self.slice_index = s
+
+    groups = _slice_groups([FakeDev(0), FakeDev(0), FakeDev(1), FakeDev(1)])
+    assert sorted(groups) == [0, 1]
+    assert all(len(v) == 2 for v in groups.values())
+
+    # single-slice path unchanged: a real mesh builds fine
+    mesh = create_mesh(data=4, model=2)
+    assert dict(mesh.shape) == {"data": 4, "model": 2}
