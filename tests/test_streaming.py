@@ -173,3 +173,62 @@ def test_imagenet_streaming_matches_eager_shape(mesh8):
     assert res["n_train"] == 48
     assert res["train_top1_error"] <= 0.6  # separable synthetic classes
     assert 0.0 <= res["test_top5_error"] <= 1.0
+
+
+REF = "/root/reference/src/test/resources"
+
+
+@pytest.mark.skipif(
+    not os.path.exists(f"{REF}/images/imagenet/n15075141.tar"),
+    reason="reference fixtures not mounted",
+)
+def test_streaming_iterator_on_reference_imagenet_tar():
+    """The streaming iterator must agree with the eager loader on the
+    reference's own ImageNet fixture tar (real layout, synset labels)."""
+    from keystone_tpu.loaders.image_loaders import (
+        load_class_map,
+        load_imagenet,
+        make_synset_label_of,
+    )
+
+    eager = load_imagenet(
+        f"{REF}/images/imagenet/n15075141.tar",
+        f"{REF}/images/imagenet-test-labels",
+        target_size=64,
+    )
+    label_of = make_synset_label_of(
+        load_class_map(f"{REF}/images/imagenet-test-labels")
+    )
+    batches = list(
+        iter_tar_image_batches(
+            f"{REF}/images/imagenet/n15075141.tar",
+            batch_size=2,
+            target_size=64,
+            label_of=label_of,
+        )
+    )
+    imgs = np.concatenate([b[1] for b in batches])
+    labels = np.concatenate([b[2] for b in batches])
+    assert imgs.shape == eager.images.shape
+    assert set(labels.tolist()) == set(np.asarray(eager.labels).tolist())
+
+
+@pytest.mark.skipif(
+    not os.path.exists(f"{REF}/images/voc/voctest.tar"),
+    reason="reference fixtures not mounted",
+)
+def test_streaming_iterator_on_reference_voc_tar():
+    from keystone_tpu.loaders.image_loaders import load_voc
+
+    eager = load_voc(
+        f"{REF}/images/voc/voctest.tar",
+        f"{REF}/images/voclabels.csv",
+        target_size=64,
+    )
+    batches = list(
+        iter_tar_image_batches(
+            f"{REF}/images/voc/voctest.tar", batch_size=3, target_size=64
+        )
+    )
+    n = sum(len(b[0]) for b in batches)
+    assert n == eager.images.shape[0]
