@@ -45,7 +45,7 @@ from keystone_tpu.parallel.mesh import (
     shard_batch,
 )
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 __all__ = [
     "Estimator",
