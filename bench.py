@@ -125,6 +125,8 @@ def bench_cifar_conv() -> dict:
         SymmetricRectifier,
     )
 
+    from keystone_tpu.core.fusion import optimize
+
     rng = np.random.default_rng(1)
     batch = jnp.asarray(
         rng.normal(size=(CIFAR_N, 32, 32, 3)).astype(np.float32)
@@ -134,7 +136,7 @@ def bench_cifar_conv() -> dict:
         rng.normal(size=(CIFAR_FILTERS, d)).astype(np.float32)
     )
     means = jnp.asarray(rng.normal(size=(d,)).astype(np.float32))
-    pipe = (
+    pipe = optimize(
         Convolver(
             filters=filters,
             whitener_means=means,

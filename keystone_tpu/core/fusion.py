@@ -1,0 +1,97 @@
+"""Pipeline operator-fusion pass.
+
+The reference has no pipeline optimizer — ``then`` composes closures
+eagerly and Spark's lazy DAG is the only plan (SURVEY.md §1). On TPU the
+flat :class:`~keystone_tpu.core.pipeline.Pipeline` node tuple IS an
+inspectable plan, so a rewrite pass is natural: :func:`optimize` walks the
+chain and replaces adjacent node groups with fused equivalents whose
+intermediate maps stay in VMEM instead of round-tripping HBM.
+
+Current rewrite rules:
+
+- ``Convolver >> SymmetricRectifier >> Pooler``  →
+  :class:`~keystone_tpu.ops.images.FusedConvRectifyPool`, whose default
+  impl pools each rectifier half *before* the channel concat so the
+  (N, oh, ow, 2F) rectified map never materializes in HBM (pooling is
+  channel-independent, so this is exact for sum/mean/max alike).
+  Applies only to default-configured Convolvers (no explicit
+  ``precision``/``impl`` override) with no Pooler ``pixel_fn`` —
+  exactly the cases with identical numerics; anything else is left
+  untouched.
+
+The pass is opt-in (``optimize(pipe)``) and structure-preserving: inputs
+that contain no rewritable window come back unchanged (same object), so
+callers can apply it unconditionally.
+"""
+
+from __future__ import annotations
+
+from keystone_tpu.core.pipeline import Pipeline, Transformer
+
+
+def _try_fuse_conv_chain(a, b, c):
+    from keystone_tpu.ops.images import (
+        Convolver,
+        FusedConvRectifyPool,
+        Pooler,
+        SymmetricRectifier,
+    )
+
+    if not (
+        isinstance(a, Convolver)
+        and isinstance(b, SymmetricRectifier)
+        and isinstance(c, Pooler)
+    ):
+        return None
+    # pixel_fn is applied to the concatenated 2F map in the unfused chain;
+    # the fused node doesn't carry it. Any pool_fn is fine: pooling is
+    # channel-independent, so pooling each rectifier half before the
+    # concat is exact for sum/mean/max alike. Explicitly configured
+    # Convolvers (precision="highest", impl="xla"/"fused") asked for
+    # specific numerics/scheduling the fused node wouldn't honor — leave
+    # those untouched.
+    if c.pixel_fn is not None:
+        return None
+    if a.precision is not None or a.impl != "auto":
+        return None
+    return FusedConvRectifyPool(
+        filters=a.filters,
+        whitener_means=a.whitener_means,
+        patch_size=a.patch_size,
+        normalize_patches=a.normalize_patches,
+        var_constant=a.var_constant,
+        alpha=b.alpha,
+        max_val=b.max_val,
+        pool_stride=c.stride,
+        pool_size=c.pool_size,
+        pool_fn=c.pool_fn,
+    )
+
+
+def optimize(pipe: Transformer) -> Transformer:
+    """Rewrite fusable node windows in a fitted pipeline.
+
+    Accepts any Transformer; only :class:`Pipeline` chains are rewritten
+    (including pipelines nested as the prefix of larger chains — the node
+    tuple is already flat by construction, ``Pipeline.of``).
+    """
+    if not isinstance(pipe, Pipeline):
+        return pipe
+    nodes = list(pipe.nodes)
+    out: list[Transformer] = []
+    i = 0
+    changed = False
+    while i < len(nodes):
+        fused = (
+            _try_fuse_conv_chain(nodes[i], nodes[i + 1], nodes[i + 2])
+            if i + 2 < len(nodes)
+            else None
+        )
+        if fused is not None:
+            out.append(fused)
+            i += 3
+            changed = True
+        else:
+            out.append(nodes[i])
+            i += 1
+    return Pipeline(nodes=tuple(out)) if changed else pipe

@@ -27,6 +27,7 @@ import numpy as np
 
 from keystone_tpu.core.batching import apply_in_chunks
 from keystone_tpu.core.config import arg, parse_config
+from keystone_tpu.core.fusion import optimize
 from keystone_tpu.core.logging import get_logger
 from keystone_tpu.evaluation import MulticlassClassifierEvaluator
 from keystone_tpu.loaders.cifar import load_cifar
@@ -123,7 +124,9 @@ def run(conf: RandomCifarConfig, mesh=None) -> dict:
         >> Pooler(stride=conf.pool_stride, pool_size=conf.pool_size)
         >> ImageVectorizer()
     )
-    feat_fn = jax.jit(lambda b, p=conv_featurizer: p(b))
+    # operator-fusion pass: pools each rectifier half before the
+    # channel concat so the (N, oh, ow, 2F) map never hits HBM
+    feat_fn = jax.jit(lambda b, p=optimize(conv_featurizer): p(b))
     t_setup = time.perf_counter()
 
     def featurize(images: np.ndarray):

@@ -16,6 +16,14 @@ faster on real v5e, so this kernel is kept as the single-chip Pallas
 exemplar rather than the auto path. Interpret mode covers the CPU test
 mesh. Layout contract matches ``extract_patches``: patch rows flattened
 (dy, dx, c), channel fastest.
+
+:func:`fused_conv_rectify_pool` extends the kernel through the
+SymmetricRectifier and Pooler stages (pooling as a 0/1-matrix gemm in
+VMEM). Same verdict on real v5e: XLA's own convolution + the
+pool-before-concat restructure (``FusedConvRectifyPool`` impl="auto")
+wins — the per-image im2col with C=3 lane writes is the bottleneck —
+so the full-chain kernel is likewise an explicitly-selected exemplar
+(impl="pallas"), numerically gated against the chain in tests.
 """
 
 from __future__ import annotations
@@ -153,6 +161,218 @@ def fused_convolver(
         interpret=interpret,
     )(batch.astype(jnp.float32), ft.astype(jnp.float32), means)
     return out[:, :rows, :f].reshape(n, oh, ow, f)
+
+
+def _conv_rect_pool_kernel(
+    img_ref,  # (1, h, w, c)
+    filt_ref,  # (P_pad, F_pad) — transposed filter bank
+    mean_ref,  # (1, P_pad) whitener means (zeros when unused)
+    pool_ref,  # (NP_pad, R_pad) 0/1 pooling matrix
+    o_ref,  # (1, NP_pad, 2*F_pad)
+    p_scr,  # (R_pad, P_pad) patch-matrix scratch
+    r_scr,  # (R_pad, 2*F_pad) rectified-map scratch
+    *,
+    patch_size: int,
+    oh: int,
+    ow: int,
+    c: int,
+    normalize: bool,
+    var_constant: float,
+    subtract_mean: bool,
+    alpha: float,
+    max_val: float,
+    f_pad: int,
+):
+    k = patch_size
+    rows = oh * ow
+    img = img_ref[0]
+    for dy in range(k):
+        for dx in range(k):
+            slab = img[dy : dy + oh, dx : dx + ow, :]
+            p_scr[:rows, (dy * k + dx) * c : (dy * k + dx + 1) * c] = (
+                slab.reshape(rows, c)
+            )
+
+    d = k * k * c
+    p = p_scr[:rows, :]
+    col = jax.lax.broadcasted_iota(jnp.int32, p.shape, 1)
+    p = jnp.where(col < d, p, 0.0)
+    if normalize:
+        mean = jnp.sum(p, axis=1, keepdims=True) / d
+        centered = jnp.where(col < d, p - mean, 0.0)
+        var = jnp.sum(centered * centered, axis=1, keepdims=True) / max(
+            d - 1, 1
+        )
+        p = centered / jnp.sqrt(var + var_constant)
+    if subtract_mean:
+        p = jnp.where(col < d, p - mean_ref[0][None, :], 0.0)
+    conv = jnp.dot(p, filt_ref[:, :], preferred_element_type=jnp.float32)
+    # SymmetricRectifier in VMEM: C → 2C channels, [pos | neg]
+    r_scr[:rows, :f_pad] = jnp.maximum(max_val, conv - alpha)
+    r_scr[:rows, f_pad:] = jnp.maximum(max_val, -conv - alpha)
+    if rows < r_scr.shape[0]:
+        # zero the padded rows: the pooling gemm touches every row and
+        # scratch starts uninitialized
+        r_scr[rows:, :] = jnp.zeros(
+            (r_scr.shape[0] - rows, r_scr.shape[1]), jnp.float32
+        )
+    # Pooler as one small gemm: pooled[p, f] = Σ_r pool[p, r] · rect[r, f]
+    o_ref[0] = jnp.dot(
+        pool_ref[:, :], r_scr[:, :], preferred_element_type=jnp.float32
+    ).astype(o_ref.dtype)
+
+
+def _num_pools(dim: int, stride: int, pool_size: int) -> int:
+    """Reference Pooler window count (nodes/images/Pooler.scala geometry:
+    windows start at 0, ``stride`` apart, edge windows truncated)."""
+    return -(-(dim - pool_size // 2) // stride)
+
+
+def _pool_matrix(
+    oh: int, ow: int, stride: int, pool_size: int
+) -> "jnp.ndarray":
+    """(ph·pw, oh·ow) 0/1 matrix summing each pool window's rows."""
+    import numpy as np
+
+    ph = _num_pools(oh, stride, pool_size)
+    pw = _num_pools(ow, stride, pool_size)
+    mat = np.zeros((ph * pw, oh * ow), np.float32)
+    for py in range(ph):
+        for px in range(pw):
+            ys = slice(py * stride, min(py * stride + pool_size, oh))
+            xs = slice(px * stride, min(px * stride + pool_size, ow))
+            block = np.zeros((oh, ow), np.float32)
+            block[ys, xs] = 1.0
+            mat[py * pw + px] = block.ravel()
+    return jnp.asarray(mat)
+
+
+def fused_conv_rectify_pool(
+    batch,
+    filters,
+    *,
+    patch_size: int,
+    normalize_patches: bool,
+    var_constant: float,
+    whitener_means=None,
+    alpha: float = 0.0,
+    max_val: float = 0.0,
+    pool_stride: int = 13,
+    pool_size: int = 14,
+    pool_fn: str = "sum",
+    interpret: bool | None = None,
+):
+    """Convolver → SymmetricRectifier → Pooler in ONE Pallas kernel.
+
+    The unfused chain materializes the (N, oh, ow, F) feature map in HBM,
+    re-reads it for the rectifier (doubling channels), and re-reads that
+    for the pooler — ~2·oh·ow/(ph·pw) times more HBM traffic than the
+    pooled result needs (≈360x on the CIFAR random-patch shape). Here the
+    conv map lives only in VMEM: im2col + normalize + filter gemm
+    (identical math to :func:`fused_convolver`), rectify on the VPU, and
+    the reference's truncated-edge pool windows applied as one 0/1-matrix
+    gemm. HBM sees the image in and the (N, ph, pw, 2F) pooled map out.
+
+    ``pool_fn``: "sum" or "mean" (matmul pooling can't express max).
+    Returns (N, ph, pw, 2F) float32, identical to the unfused chain
+    (mean variant divides by pool_size² — the reference's edge-window
+    quirk, nodes/images/Pooler.scala).
+    """
+    if pool_fn not in ("sum", "mean"):
+        raise ValueError(f"pool_fn={pool_fn!r}: fused path is sum|mean only")
+    if interpret is None:
+        interpret = not on_tpu()
+    n, h, w, c = batch.shape
+    k = patch_size
+    f = filters.shape[0]
+    oh, ow, rows, rows_pad, p_pad, f_pad = _padded_dims(h, w, c, k, f)
+    d = k * k * c
+    ph = _num_pools(oh, pool_stride, pool_size)
+    pw = _num_pools(ow, pool_stride, pool_size)
+    np_pad = -(-(ph * pw) // 8) * 8
+
+    ft = _pad_to(_pad_to(filters.T, 0, _LANE), 1, _LANE)
+    means = (
+        jnp.zeros((1, p_pad), jnp.float32)
+        if whitener_means is None
+        else _pad_to(
+            jnp.asarray(whitener_means, jnp.float32).reshape(1, d), 1, _LANE
+        )
+    )
+    pool_mat = _pad_to(
+        _pad_to(_pool_matrix(oh, ow, pool_stride, pool_size), 0, 8),
+        1,
+        8,
+    )
+    assert pool_mat.shape == (np_pad, rows_pad)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _conv_rect_pool_kernel,
+            patch_size=k,
+            oh=oh,
+            ow=ow,
+            c=c,
+            normalize=normalize_patches,
+            var_constant=var_constant,
+            subtract_mean=whitener_means is not None,
+            alpha=alpha,
+            max_val=max_val,
+            f_pad=f_pad,
+        ),
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((1, h, w, c), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((p_pad, f_pad), lambda i: (0, 0)),
+            pl.BlockSpec((1, p_pad), lambda i: (0, 0)),
+            pl.BlockSpec((np_pad, rows_pad), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, np_pad, 2 * f_pad), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, np_pad, 2 * f_pad), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((rows_pad, p_pad), jnp.float32),
+            pltpu.VMEM((rows_pad, 2 * f_pad), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",),
+            vmem_limit_bytes=None if interpret else _vmem_limit_bytes(),
+        ),
+        interpret=interpret,
+    )(batch.astype(jnp.float32), ft.astype(jnp.float32), means, pool_mat)
+    # channel layout: [pos f | neg f] — slice each half past the lane pad
+    pos = out[:, : ph * pw, :f]
+    neg = out[:, : ph * pw, f_pad : f_pad + f]
+    res = jnp.concatenate([pos, neg], axis=-1).reshape(n, ph, pw, 2 * f)
+    if pool_fn == "mean":
+        res = res / float(pool_size * pool_size)
+    return res
+
+
+def fused_conv_rectify_pool_fits(
+    h: int,
+    w: int,
+    c: int,
+    patch_size: int,
+    num_filters: int,
+    pool_stride: int,
+    pool_size: int,
+) -> bool:
+    """VMEM gate for :func:`fused_conv_rectify_pool` (same double-buffer
+    accounting as :func:`fused_convolver_fits`, plus the rectified-map
+    scratch and the pooling-matrix / pooled-output operands)."""
+    oh, ow, _, rows_pad, p_pad, f_pad = _padded_dims(
+        h, w, c, patch_size, num_filters
+    )
+    ph = _num_pools(oh, pool_stride, pool_size)
+    pw = _num_pools(ow, pool_stride, pool_size)
+    np_pad = -(-(ph * pw) // 8) * 8
+    bytes_needed = 4 * (
+        2 * (h * w * c + p_pad * f_pad + np_pad * rows_pad + np_pad * 2 * f_pad)
+        + rows_pad * p_pad
+        + rows_pad * 2 * f_pad
+    )
+    limit = _vmem_limit_bytes() or 16 * 1024 * 1024
+    return bytes_needed <= (2 * limit) // 3
 
 
 def fused_convolver_fits(h: int, w: int, c: int, patch_size: int,

@@ -316,6 +316,103 @@ class Pooler(Transformer):
 
 
 @treenode
+class FusedConvRectifyPool(Transformer):
+    """``Convolver >> SymmetricRectifier >> Pooler`` as one node.
+
+    Produced by :func:`keystone_tpu.core.fusion.optimize`; carries the
+    union of the three nodes' parameters. Implementations:
+
+    - ``auto`` (default): conv-algebra convolution, then each rectifier
+      half is pooled *before* the channel concat. The unfused chain's
+      ``concatenate`` forces XLA to materialize the (N, oh, ow, 2F) map
+      in HBM between the rectifier and the pooler; pooling each half
+      first keeps the rectifier fused into ``reduce_window``'s operand
+      and the concat runs on the tiny pooled map (measured ~12% e2e on
+      v5e at the CIFAR random-patch shape, and the 2F map never exists).
+    - ``pallas``: the single fused VMEM kernel
+      (:func:`keystone_tpu.ops.conv_kernel.fused_conv_rectify_pool`).
+      Kept as the exemplar; measured *slower* than ``auto`` on v5e —
+      per-image im2col with C=3 lanes can't compete with XLA's conv.
+    - ``unfused``: the literal three-node chain (parity baseline).
+
+    Output is identical in shape/layout to the chain: (N, ph, pw, 2F),
+    channels ``[pos | neg]``.
+    """
+
+    filters: jnp.ndarray
+    whitener_means: jnp.ndarray | None = None
+    patch_size: int = static_field(default=6)
+    normalize_patches: bool = static_field(default=True)
+    var_constant: float = static_field(default=10.0)
+    alpha: float = static_field(default=0.0)
+    max_val: float = static_field(default=0.0)
+    pool_stride: int = static_field(default=13)
+    pool_size: int = static_field(default=14)
+    pool_fn: str = static_field(default="sum")
+    impl: str = static_field(default="auto")  # auto | pallas | unfused
+
+    def _unfused(self) -> Transformer:
+        from keystone_tpu.core.pipeline import Pipeline
+
+        return Pipeline.of(
+            Convolver(
+                filters=self.filters,
+                whitener_means=self.whitener_means,
+                patch_size=self.patch_size,
+                normalize_patches=self.normalize_patches,
+                var_constant=self.var_constant,
+            ),
+            SymmetricRectifier(max_val=self.max_val, alpha=self.alpha),
+            Pooler(
+                stride=self.pool_stride,
+                pool_size=self.pool_size,
+                pool_fn=self.pool_fn,
+            ),
+        )
+
+    def __call__(self, batch):
+        if self.impl not in ("auto", "pallas", "unfused"):
+            raise ValueError(
+                f"FusedConvRectifyPool impl={self.impl!r}; "
+                "expected auto|pallas|unfused"
+            )
+        if self.impl == "unfused":
+            return self._unfused()(batch)
+        if self.impl == "pallas":
+            from keystone_tpu.ops import conv_kernel
+
+            return conv_kernel.fused_conv_rectify_pool(
+                batch,
+                self.filters,
+                patch_size=self.patch_size,
+                normalize_patches=self.normalize_patches,
+                var_constant=self.var_constant,
+                whitener_means=self.whitener_means,
+                alpha=self.alpha,
+                max_val=self.max_val,
+                pool_stride=self.pool_stride,
+                pool_size=self.pool_size,
+                pool_fn=self.pool_fn,
+            )
+        conv = conv_convolver(
+            batch,
+            self.filters,
+            patch_size=self.patch_size,
+            normalize_patches=self.normalize_patches,
+            var_constant=self.var_constant,
+            whitener_means=self.whitener_means,
+        )
+        pool = Pooler(
+            stride=self.pool_stride,
+            pool_size=self.pool_size,
+            pool_fn=self.pool_fn,
+        )
+        pos = pool(jnp.maximum(self.max_val, conv - self.alpha))
+        neg = pool(jnp.maximum(self.max_val, -conv - self.alpha))
+        return jnp.concatenate([pos, neg], axis=-1)
+
+
+@treenode
 class LabelExtractor(Transformer):
     """Project labels out of a LabeledImages batch
     (reference nodes/images/LabeledImageExtractors.scala)."""

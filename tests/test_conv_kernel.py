@@ -80,5 +80,166 @@ def test_conv_algebra_matches_xla(rng, h, w, c, k, f, norm, whiten):
 
 
 def test_vmem_budget_gate():
+    from keystone_tpu.ops.conv_kernel import fused_conv_rectify_pool_fits
+
     assert fused_convolver_fits(32, 32, 3, 6, 256)  # CIFAR-scale: fits
     assert not fused_convolver_fits(512, 512, 3, 12, 4096)  # too big
+    assert fused_conv_rectify_pool_fits(32, 32, 3, 6, 256, 13, 14)
+    assert not fused_conv_rectify_pool_fits(512, 512, 3, 12, 4096, 13, 14)
+
+
+@pytest.mark.parametrize(
+    "h,w,c,k,f,stride,psize,norm,whiten,pool_fn",
+    [
+        (32, 32, 3, 6, 32, 13, 14, True, True, "sum"),  # RandomPatchCifar
+        (20, 16, 3, 5, 17, 4, 6, True, False, "sum"),  # truncated edges
+        (12, 12, 1, 3, 8, 3, 4, False, True, "mean"),
+        (11, 13, 2, 4, 16, 5, 5, False, False, "sum"),  # odd dims
+    ],
+)
+def test_fused_conv_rectify_pool_matches_chain(
+    rng, h, w, c, k, f, stride, psize, norm, whiten, pool_fn
+):
+    """The fused conv→rectify→pool kernel must match the unfused three-node
+    chain (Convolver >> SymmetricRectifier >> Pooler) bit-for-layout and to
+    f32 tolerance relative to the pooled magnitudes."""
+    from keystone_tpu.ops.conv_kernel import fused_conv_rectify_pool
+    from keystone_tpu.ops.images import Pooler, SymmetricRectifier
+
+    batch = jnp.asarray(rng.normal(size=(3, h, w, c)).astype(np.float32))
+    filters = jnp.asarray(rng.normal(size=(f, k * k * c)).astype(np.float32))
+    wm = (
+        jnp.asarray(rng.normal(size=(k * k * c,)).astype(np.float32))
+        if whiten
+        else None
+    )
+    chain = (
+        Convolver(
+            filters=filters,
+            whitener_means=wm,
+            patch_size=k,
+            normalize_patches=norm,
+        )
+        >> SymmetricRectifier(alpha=0.25)
+        >> Pooler(stride=stride, pool_size=psize, pool_fn=pool_fn)
+    )
+    ref = chain(batch)
+    out = fused_conv_rectify_pool(
+        batch,
+        filters,
+        patch_size=k,
+        normalize_patches=norm,
+        var_constant=10.0,
+        whitener_means=wm,
+        alpha=0.25,
+        pool_stride=stride,
+        pool_size=psize,
+        pool_fn=pool_fn,
+        interpret=True,
+    )
+    assert out.shape == ref.shape
+    scale = float(np.abs(np.asarray(ref)).max()) or 1.0
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=1e-5 * scale
+    )
+
+
+def test_fusion_pass_rewrites_conv_chain(rng):
+    """optimize() swaps Convolver>>SymmetricRectifier>>Pooler for the fused
+    node, leaves other nodes alone, and preserves numerics."""
+    from keystone_tpu.core.fusion import optimize
+    from keystone_tpu.ops.images import (
+        FusedConvRectifyPool,
+        ImageVectorizer,
+        Pooler,
+        SymmetricRectifier,
+    )
+
+    f, k = 8, 3
+    filters = jnp.asarray(rng.normal(size=(f, k * k * 3)).astype(np.float32))
+    pipe = (
+        Convolver(filters=filters, patch_size=k, normalize_patches=True)
+        >> SymmetricRectifier(alpha=0.1)
+        >> Pooler(stride=3, pool_size=4)
+        >> ImageVectorizer()
+    )
+    opt = optimize(pipe)
+    assert [type(n).__name__ for n in opt.nodes] == [
+        "FusedConvRectifyPool",
+        "ImageVectorizer",
+    ]
+    fused = opt.nodes[0]
+    assert isinstance(fused, FusedConvRectifyPool)
+    batch = jnp.asarray(rng.normal(size=(2, 12, 12, 3)).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(opt(batch)), np.asarray(pipe(batch)), atol=1e-4
+    )
+
+
+def test_fusion_pass_max_pool_and_skips(rng):
+    """max pooling fuses too (pooling is channel-independent, so pooling
+    each rectifier half before the concat is exact); pixel_fn pools must
+    NOT be fused; non-Pipeline inputs come back unchanged."""
+    from keystone_tpu.core.fusion import optimize
+    from keystone_tpu.ops.images import Pooler, SymmetricRectifier
+
+    f, k = 4, 3
+    filters = jnp.asarray(rng.normal(size=(f, k * k * 3)).astype(np.float32))
+    conv = Convolver(filters=filters, patch_size=k)
+    maxpool_pipe = (
+        conv >> SymmetricRectifier() >> Pooler(stride=3, pool_size=4, pool_fn="max")
+    )
+    opt = optimize(maxpool_pipe)
+    assert len(opt.nodes) == 1
+    batch = jnp.asarray(rng.normal(size=(2, 12, 12, 3)).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(opt(batch)), np.asarray(maxpool_pipe(batch)), atol=1e-4
+    )
+    fnpool_pipe = (
+        conv
+        >> SymmetricRectifier()
+        >> Pooler(stride=3, pool_size=4, pixel_fn=jnp.abs)
+    )
+    assert optimize(fnpool_pipe) is fnpool_pipe
+    assert optimize(conv) is conv
+    # explicitly configured convolvers asked for specific numerics or
+    # scheduling — the pass must not override them
+    for special in (
+        Convolver(filters=filters, patch_size=k, precision="highest"),
+        Convolver(filters=filters, patch_size=k, impl="xla"),
+    ):
+        pipe = special >> SymmetricRectifier() >> Pooler(stride=3, pool_size=4)
+        assert optimize(pipe) is pipe
+
+
+@pytest.mark.parametrize("impl", ["auto", "pallas", "unfused"])
+def test_fused_node_impls_agree(rng, impl):
+    """Every FusedConvRectifyPool impl must match the literal chain."""
+    from keystone_tpu.ops.images import (
+        FusedConvRectifyPool,
+        Pooler,
+        SymmetricRectifier,
+    )
+
+    f, k = 16, 4
+    filters = jnp.asarray(rng.normal(size=(f, k * k * 3)).astype(np.float32))
+    wm = jnp.asarray(rng.normal(size=(k * k * 3,)).astype(np.float32))
+    chain = (
+        Convolver(filters=filters, whitener_means=wm, patch_size=k)
+        >> SymmetricRectifier(alpha=0.1)
+        >> Pooler(stride=4, pool_size=5)
+    )
+    node = FusedConvRectifyPool(
+        filters=filters,
+        whitener_means=wm,
+        patch_size=k,
+        alpha=0.1,
+        pool_stride=4,
+        pool_size=5,
+        impl=impl,
+    )
+    batch = jnp.asarray(rng.normal(size=(2, 14, 15, 3)).astype(np.float32))
+    ref = np.asarray(chain(batch))
+    out = np.asarray(node(batch))
+    scale = float(np.abs(ref).max()) or 1.0
+    np.testing.assert_allclose(out, ref, atol=1e-5 * scale)

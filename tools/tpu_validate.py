@@ -360,6 +360,74 @@ def validate_fused_convolver(results):
     )
 
 
+def validate_weighted_solver_scale(results):
+    """Weighted-BCD scaling on the real chip (round-1 VERDICT #3 done
+    criteria): (a) TIMIT shape (C=147) fit cost vs the unweighted BCD at
+    the same shape, (b) an ImageNet-class-count feasibility run (C=1000,
+    4096 feature columns) — the class-sorted grid layout keeps per-class
+    Grams at N·d² total, so C only enters through the batched per-class
+    solves (reference BlockWeightedLeastSquares.scala:228-263 runs these
+    one-class-per-partition; here they are chunked batched Cholesky
+    solves)."""
+    from keystone_tpu.ops.linear import BlockLeastSquaresEstimator
+    from keystone_tpu.ops.util import ClassLabelIndicators
+    from keystone_tpu.ops.weighted_linear import (
+        BlockWeightedLeastSquaresEstimator,
+    )
+
+    rng = np.random.default_rng(5)
+
+    def run(n, d, block, c, chunk):
+        data = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+        labels_i = rng.integers(0, c, size=n).astype(np.int32)
+        y = jnp.asarray(np.asarray(ClassLabelIndicators(num_classes=c)(labels_i)))
+        west = BlockWeightedLeastSquaresEstimator(
+            block_size=block,
+            num_iter=1,
+            lam=0.5,
+            mixture_weight=0.3,
+            class_chunk=chunk,
+        )
+        fitted = {}
+
+        def step():
+            fitted["model"] = west.fit(data, y, n_valid=n)
+            return fitted["model"]
+
+        t = _time(step, iters=3)
+        model = fitted["model"]
+        assert bool(jnp.isfinite(model.b).all()), "non-finite intercepts"
+        for x in model.xs:
+            assert bool(jnp.isfinite(x).all()), "non-finite model block"
+        return t, data, y
+
+    # (a) TIMIT shape: 147 classes, 2048 cols in 4 blocks
+    n, d = 16384, 2048
+    t_w, data, y = run(n, d, 512, 147, 21)
+    est = BlockLeastSquaresEstimator(block_size=512, num_iter=1, lam=0.5)
+    blocks = [data[:, i : i + 512] for i in range(0, d, 512)]
+    t_u = _time(lambda: est.fit(blocks, y, n_valid=n), iters=3)
+    results["weighted_solver_timit_c147"] = {
+        "n": n,
+        "d": d,
+        "classes": 147,
+        "weighted_ms": round(t_w * 1e3, 1),
+        "unweighted_ms": round(t_u * 1e3, 1),
+        "ratio": round(t_w / t_u, 2),
+    }
+
+    # (b) ImageNet class count: C=1000, 4096 cols in 2 blocks of 2048
+    t_k, _, _ = run(16384, 4096, 2048, 1000, 8)
+    results["weighted_solver_imagenet_c1000"] = {
+        "n": 16384,
+        "d": 4096,
+        "classes": 1000,
+        "fit_ms": round(t_k * 1e3, 1),
+        "note": "feasibility: one full weighted-BCD pass, class-sorted "
+        "grid layout, chunked batched per-class solves",
+    }
+
+
 def validate_long_context(results):
     """32k-token causal attention: flash completes on one chip where the
     dense path cannot even compile (the (S, S) score tensor exceeds HBM).
@@ -402,6 +470,7 @@ def main() -> int:
     validate_flash_attention(results)
     validate_flash_step(results)
     validate_fused_convolver(results)
+    validate_weighted_solver_scale(results)
     if os.environ.get("TPU_VALIDATE_LONG"):
         validate_long_context(results)
     out = REPO / "TPU_VALIDATION.json"
