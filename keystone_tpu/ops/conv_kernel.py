@@ -223,9 +223,12 @@ def _conv_rect_pool_kernel(
 
 
 def _num_pools(dim: int, stride: int, pool_size: int) -> int:
-    """Reference Pooler window count (nodes/images/Pooler.scala geometry:
-    windows start at 0, ``stride`` apart, edge windows truncated)."""
-    return -(-(dim - pool_size // 2) // stride)
+    """Reference Pooler window count — delegates to the single source of
+    truth (:meth:`keystone_tpu.ops.images.Pooler._num_pools`) so the fused
+    kernel can never drift from the chain it must match."""
+    from keystone_tpu.ops.images import Pooler
+
+    return Pooler(stride=stride, pool_size=pool_size)._num_pools(dim)
 
 
 def _pool_matrix(

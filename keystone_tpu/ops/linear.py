@@ -81,6 +81,31 @@ def ridge_solve(
     return x
 
 
+def stabilized_cho_solve(mat: jnp.ndarray, jitter: float = 1e-6):
+    """Factor a symmetric PSD ``mat`` once, return a multi-RHS solver.
+
+    Same Jacobi-equilibration + relative-jitter stabilization as
+    :func:`ridge_solve` (f32 Grams on TPU), but exposed as a reusable
+    closure so callers that solve against ONE base matrix with many
+    right-hand sides (e.g. the weighted solver's Woodbury path) pay the
+    O(d³) factorization once and every solve is triangular-substitution
+    gemms. The returned fn maps (d, k) → (d, k).
+    """
+    d = mat.shape[0]
+    inv_s = jax.lax.rsqrt(jnp.clip(jnp.diagonal(mat), 1e-30, None))
+    m = mat * (inv_s[:, None] * inv_s[None, :]) + jitter * jnp.eye(
+        d, dtype=mat.dtype
+    )
+    cf = jax.scipy.linalg.cho_factor(m)
+
+    def solve(rhs):
+        return inv_s[:, None] * jax.scipy.linalg.cho_solve(
+            cf, rhs * inv_s[:, None]
+        )
+
+    return solve
+
+
 @treenode
 class LinearMapper(Transformer):
     """``in @ x + b`` with an optional feature scaler applied first

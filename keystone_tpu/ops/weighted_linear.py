@@ -210,8 +210,14 @@ def _weighted_bcd_fit(
             return x.reshape(c, class_l, *x.shape[1:]).sum(axis=1)
         return jnp.einsum("nc,n...->c...", onehot, x)
 
-    # pass-0 cached per-block statistics (reference BlockStatistics)
-    pop_means, pop_covs, joint_means = [], [], []
+    # pass-0 cached per-block statistics (reference BlockStatistics), plus
+    # — when the Woodbury path applies — the explicit stabilized inverse
+    # of the pass-invariant base matrix B = (1−w)·pop_cov + λI (one
+    # Cholesky per block per FIT, not per pass; see solve-path comment in
+    # the block loop below)
+    from keystone_tpu.ops.linear import stabilized_cho_solve
+
+    pop_means, pop_covs, joint_means, b_invs = [], [], [], []
     for a in blocks:
         a_m = a * mask
         pop_mean = jnp.sum(a_m, axis=0) / n
@@ -222,6 +228,14 @@ def _weighted_bcd_fit(
         pop_means.append(pop_mean)
         pop_covs.append(pop_cov)
         joint_means.append(joint_mean)
+        d_blk = a.shape[-1]
+        if class_l is not None and class_l + 2 <= d_blk // 2:
+            eye = jnp.eye(d_blk, dtype=dtype)
+            b_invs.append(
+                stabilized_cho_solve((1 - w) * pop_cov + lam * eye)(eye)
+            )
+        else:
+            b_invs.append(None)
 
     n_chunks = -(-c // class_chunk)
     c_pad = n_chunks * class_chunk
@@ -231,14 +245,110 @@ def _weighted_bcd_fit(
         pad[axis] = (0, c_pad - c)
         return jnp.pad(x, pad)
 
-    xs = [jnp.zeros((a.shape[-1], c), dtype) for a in blocks]
+    xs = tuple(jnp.zeros((a.shape[-1], c), dtype) for a in blocks)
 
-    for _ in range(num_iter):
+    def chunk_rhs(s):
+        joint_xtr = (
+            (1 - w) * s["pop_xtr"]
+            + w * s["class_xtr"]
+            - s["joint_mean"] * s["mean_mix"][:, None]
+        )
+        return joint_xtr - lam * s["model_col"]  # (S, d)
+
+    # Per-class systems are (joint_xtx_c + λI) δ_c = rhs_c with
+    #   joint_xtx_c = (1−w)·pop_cov + w·class_cov_c + w(1−w)·md_c md_cᵀ ,
+    # and class_cov_c built from only n_c ≈ N/C rows — LOW RANK when
+    # classes are small relative to the block width. Dense per-class
+    # Cholesky costs C·d³/3 and TPU factorizations run at a fixed
+    # ~15-30 ms per 147-matrix batch on v5e REGARDLESS of size
+    # (sequential panels), so when the grid layout is active and the
+    # correction rank L+2 ≤ d/2 the solves go through Woodbury instead.
+    # The correction splits as V Vᵀ − q qᵀ with
+    #   V = [√(w/n_c)·A_cᵀ, √(w(1−w))·md]   (L+1 POSITIVE columns)
+    #   q = √w·mu ,
+    # so M = B + VVᵀ − qqᵀ with shared SPD base B = (1−w)·pop_cov + λI.
+    # M1 = B + VVᵀ inverts by Woodbury with SPD inner G = I + VᵀB⁻¹V;
+    # the −qqᵀ downdate folds in by Sherman–Morrison (scalars only).
+    # G⁻¹ comes from a fixed-depth Newton–Schulz iteration (two (L+1)²
+    # gemms per step; G's eigenvalues are ≥ 1 so the scaled-identity
+    # init converges quadratically) — the whole per-class pipeline is
+    # factorization-free gemms on the MXU, 5-40x faster than batched
+    # dense Cholesky at TIMIT/ImageNet class counts. (The reference
+    # solves each class densely on its own executor,
+    # BlockWeightedLeastSquares.scala:228-263 — right on CPUs, wrong on
+    # a systolic array.) Everything except the right-hand side is
+    # pass-invariant, so v/y/ginv/q/p/denom are built ONCE per fit here
+    # (costs ~2·C·d·(L+1) floats of HBM — the same order as the grid
+    # copy itself) and the per-pass work is rhs assembly + solves.
+    use_woodbury = [
+        class_l is not None and class_l + 2 <= a.shape[-1] // 2
+        for a in blocks
+    ]
+    wood_pre = []
+    for i, a in enumerate(blocks):
+        if not use_woodbury[i]:
+            wood_pre.append(None)
+            continue
+        a_m = a * mask
+        class_mean = class_sum(a_m) / n_c_safe[:, None]  # (C, d)
+        static = {
+            "class_mean": pad_classes(class_mean, 0).reshape(
+                n_chunks, class_chunk, -1
+            ),
+            "n_c": pad_classes(n_c_safe, 0).reshape(n_chunks, class_chunk),
+            "a_rows": pad_classes(a_m.reshape(c, class_l, -1), 0).reshape(
+                n_chunks, class_chunk, class_l, -1
+            ),
+        }
+        lp1 = class_l + 1
+
+        def prep_chunk(s, b_inv=b_invs[i], pop_mean=pop_means[i], lp1=lp1):
+            mu = s["class_mean"]  # (S, d)
+            md = mu - pop_mean
+            scale = jnp.sqrt(w / jnp.maximum(s["n_c"], 1.0))
+            v = jnp.concatenate(
+                [
+                    s["a_rows"].transpose(0, 2, 1) * scale[:, None, None],
+                    (np.sqrt(w * (1 - w)) * md)[:, :, None],
+                ],
+                axis=2,
+            )  # (S, d, L+1)
+            q = np.sqrt(w) * mu  # (S, d)
+            y = jnp.einsum("de,sek->sdk", b_inv, v)  # B⁻¹V
+            g = jnp.einsum("sdi,sdj->sij", v, y) + jnp.eye(lp1, dtype=dtype)
+            # Newton–Schulz: X ← X(2I − GX), X₀ = I/‖G‖₁;
+            # eigs(GX₀) ∈ (0, 1], error contracts as (1−λ/‖G‖₁)^(2^k)
+            norm1 = jnp.max(jnp.sum(jnp.abs(g), axis=-1), axis=-1)
+            x_ns = jnp.eye(lp1, dtype=dtype)[None] / norm1[:, None, None]
+            eye2 = 2.0 * jnp.eye(lp1, dtype=dtype)
+            ginv = jax.lax.fori_loop(
+                0, 16, lambda _, xk: xk @ (eye2 - g @ xk), x_ns
+            )
+            z = jnp.einsum("de,se->sd", b_inv, q)
+            t = jnp.einsum(
+                "sij,sj->si", ginv, jnp.einsum("sdi,sd->si", v, z)
+            )
+            p = z - jnp.einsum("sdi,si->sd", y, t)  # M1⁻¹q
+            denom = 1.0 - jnp.einsum("sd,sd->s", q, p)  # > 0: M is PD
+            return {
+                "v": v, "y": y, "ginv": ginv, "q": q, "p": p,
+                "denom": denom,
+            }
+
+        wood_pre.append(jax.lax.map(prep_chunk, static))
+
+    # one full BCD sweep (every block) per fori_loop step: the program is
+    # traced/compiled ONCE per block regardless of num_iter (an unrolled
+    # pass loop made compile time scale linearly with passes)
+    def one_pass(_p, state):
+        xs, resid, res_mean = state
+        xs = list(xs)
         for i, a in enumerate(blocks):
             a_m = a * mask
-            pop_mean, pop_cov, joint_mean = pop_means[i], pop_covs[i], joint_means[i]
+            pop_mean, pop_cov, joint_mean = (
+                pop_means[i], pop_covs[i], joint_means[i],
+            )
             pop_xtr = (a_m.T @ resid) / n  # (d, C)
-            class_mean = class_sum(a_m) / n_c_safe[:, None]  # (C, d)
             # per-class residual stats restricted to own-class rows/column
             r_own = jnp.sum(resid * onehot, axis=-1, keepdims=True)  # (N, 1)
             class_xtr = class_sum(a_m * r_own) / n_c_safe[:, None]  # (C, d)
@@ -247,11 +357,8 @@ def _weighted_bcd_fit(
             mean_mix = (1 - w) * res_mean + w * r_own_mean  # (C,)
             model = xs[i]
 
-            # chunked per-class covariance + solve
+            # per-pass chunked stats: everything the rhs needs
             stats = {
-                "class_mean": pad_classes(class_mean, 0).reshape(
-                    n_chunks, class_chunk, -1
-                ),
                 "class_xtr": pad_classes(class_xtr, 0).reshape(
                     n_chunks, class_chunk, -1
                 ),
@@ -267,54 +374,110 @@ def _weighted_bcd_fit(
                 "model_col": pad_classes(model.T, 0).reshape(
                     n_chunks, class_chunk, -1
                 ),
-                "n_c": pad_classes(n_c_safe, 0).reshape(n_chunks, class_chunk),
             }
-            if class_l is not None:
-                # class-sorted rows: the chunk's own rows as (S, L, d) —
-                # per-class Grams are batched gemms over L rows each
-                stats["a_rows"] = pad_classes(
-                    a_m.reshape(c, class_l, -1), 0
-                ).reshape(n_chunks, class_chunk, class_l, -1)
+
+            if use_woodbury[i]:
+
+                def solve_chunk(args, b_inv=b_invs[i], pop_cov=pop_cov):
+                    pre, s = args
+                    v, y, ginv = pre["v"], pre["y"], pre["ginv"]
+                    q, p, denom = pre["q"], pre["p"], pre["denom"]
+
+                    def m1solve(r):  # (B + VVᵀ)⁻¹ r, all gemms
+                        z = jnp.einsum("de,se->sd", b_inv, r)
+                        t = jnp.einsum(
+                            "sij,sj->si",
+                            ginv,
+                            jnp.einsum("sdi,sd->si", v, z),
+                        )
+                        return z - jnp.einsum("sdi,si->sd", y, t)
+
+                    def wsolve(r):  # M⁻¹r via Sherman–Morrison downdate
+                        u1 = m1solve(r)
+                        coef = jnp.einsum("sd,sd->s", q, u1) / denom
+                        return u1 + p * coef[:, None]
+
+                    def matvec(x):  # (joint_xtx + λI) x, never formed
+                        bx = (1 - w) * jnp.einsum(
+                            "de,se->sd", pop_cov, x
+                        ) + lam * x
+                        vx = jnp.einsum("sdi,sd->si", v, x)
+                        qx = jnp.einsum("sd,sd->s", q, x)
+                        return (
+                            bx
+                            + jnp.einsum("sdi,si->sd", v, vx)
+                            - q * qx[:, None]
+                        )
+
+                    rhs = chunk_rhs(s)
+                    x = wsolve(rhs)
+                    for _ in range(3):  # NS inverse is approximate: one
+                        # extra refine step vs ridge_solve's two
+                        x = x + wsolve(rhs - matvec(x))
+                    return x  # (S, d)
+
+                deltas = jax.lax.map(solve_chunk, (wood_pre[i], stats))
             else:
-                oh_chunks = pad_classes(onehot, 1).reshape(
-                    n_rows, n_chunks, class_chunk
+                # dense per-class normal equations (big classes or the
+                # traced-label masked fallback)
+                class_mean = class_sum(a_m) / n_c_safe[:, None]  # (C, d)
+                stats["class_mean"] = pad_classes(class_mean, 0).reshape(
+                    n_chunks, class_chunk, -1
                 )
-                stats["onehot"] = jnp.moveaxis(oh_chunks, 1, 0)  # (K, N, S)
-
-            def solve_chunk(s, a_m=a_m, pop_cov=pop_cov, pop_mean=pop_mean):
+                stats["n_c"] = pad_classes(n_c_safe, 0).reshape(
+                    n_chunks, class_chunk
+                )
                 if class_l is not None:
-                    # (S, L, d) → (S, d, d): N·d² total across chunks
-                    g = jnp.einsum("sld,sle->sde", s["a_rows"], s["a_rows"])
+                    # class-sorted rows: the chunk's own rows as
+                    # (S, L, d) — per-class Grams are batched gemms
+                    stats["a_rows"] = pad_classes(
+                        a_m.reshape(c, class_l, -1), 0
+                    ).reshape(n_chunks, class_chunk, class_l, -1)
                 else:
-                    # masked full-batch reduction: C·N·d² (traced-label path)
-                    g = jnp.einsum("nd,ns,ne->sde", a_m, s["onehot"], a_m)
-                mu = s["class_mean"]  # (S, d)
-                class_cov = g / s["n_c"][:, None, None] - jnp.einsum(
-                    "sd,se->sde", mu, mu
-                )
-                md = mu - pop_mean  # (S, d)
-                joint_xtx = (
-                    (1 - w) * pop_cov[None]
-                    + w * class_cov
-                    + w * (1 - w) * jnp.einsum("sd,se->sde", md, md)
-                )
-                joint_xtr = (
-                    (1 - w) * s["pop_xtr"]
-                    + w * s["class_xtr"]
-                    - s["joint_mean"] * s["mean_mix"][:, None]
-                )
-                rhs = joint_xtr - lam * s["model_col"]  # (S, d)
-                delta = jax.vmap(
-                    lambda m, r: ridge_solve(m, r[:, None], lam)[:, 0]
-                )(joint_xtx, rhs)
-                return delta  # (S, d)
+                    oh_chunks = pad_classes(onehot, 1).reshape(
+                        n_rows, n_chunks, class_chunk
+                    )
+                    stats["onehot"] = jnp.moveaxis(oh_chunks, 1, 0)
 
-            deltas = jax.lax.map(solve_chunk, stats)  # (K, S, d)
+                def solve_chunk(
+                    s, a_m=a_m, pop_cov=pop_cov, pop_mean=pop_mean
+                ):
+                    if class_l is not None:
+                        # (S, L, d) → (S, d, d): N·d² total across chunks
+                        g = jnp.einsum(
+                            "sld,sle->sde", s["a_rows"], s["a_rows"]
+                        )
+                    else:
+                        # masked full-batch reduction: C·N·d²
+                        g = jnp.einsum(
+                            "nd,ns,ne->sde", a_m, s["onehot"], a_m
+                        )
+                    mu = s["class_mean"]  # (S, d)
+                    class_cov = g / s["n_c"][:, None, None] - jnp.einsum(
+                        "sd,se->sde", mu, mu
+                    )
+                    md = mu - pop_mean  # (S, d)
+                    joint_xtx = (
+                        (1 - w) * pop_cov[None]
+                        + w * class_cov
+                        + w * (1 - w) * jnp.einsum("sd,se->sde", md, md)
+                    )
+                    delta = jax.vmap(
+                        lambda m, r: ridge_solve(m, r[:, None], lam)[:, 0]
+                    )(joint_xtx, chunk_rhs(s))
+                    return delta  # (S, d)
+
+                deltas = jax.lax.map(solve_chunk, stats)  # (K, S, d)
+
             delta = deltas.reshape(c_pad, -1)[:c].T  # (d, C)
-
             xs[i] = xs[i] + delta
             resid = resid - a_m @ delta
             res_mean = residual_mean(resid)
+        return tuple(xs), resid, res_mean
+
+    xs, resid, res_mean = jax.lax.fori_loop(
+        0, num_iter, one_pass, (xs, resid, res_mean)
+    )
 
     # final intercept: b[c] = jointLabelMean[c] − Σ_blocks jointMean_c·x[:,c]
     b = joint_label_mean

@@ -129,6 +129,60 @@ def test_weighted_predictions_favor_upweighted_class(rng):
     assert rare_recall(0.9) >= rare_recall(0.1)
 
 
+def test_woodbury_path_matches_exact_optimum(rng):
+    """At wide blocks with small classes (class_l + 2 ≤ d_block/2) the grid
+    layout switches the per-class solves to the Woodbury low-rank path —
+    it must still land on the closed-form weighted-ridge optimum and agree
+    with the masked dense fallback."""
+    import jax
+
+    from keystone_tpu.ops.weighted_linear import (
+        BlockWeightedLeastSquaresEstimator,
+        _weighted_bcd_fit,
+    )
+
+    n, d, c = 400, 160, 8
+    a, y = _data(rng, n=n, d=d, c=c)
+    lam, w = 0.2, 0.35
+    a64, y64 = a.astype(np.float64), y.astype(np.float64)
+    cls = y.argmax(1)
+    counts = np.bincount(cls, minlength=c).astype(np.float64)
+    a1 = np.concatenate([a64, np.ones((n, 1))], axis=1)
+    x_opt = np.zeros((d, c))
+    b_opt = np.zeros(c)
+    for k in range(c):
+        wts = np.full(n, (1 - w) / n)
+        wts[cls == k] += w / counts[k]
+        m = (a1.T * wts) @ a1
+        reg = np.eye(d + 1) * lam
+        reg[d, d] = 0.0
+        sol = np.linalg.solve(m + reg, a1.T @ (wts * y64[:, k]))
+        x_opt[:, k], b_opt[k] = sol[:d], sol[d]
+
+    est = BlockWeightedLeastSquaresEstimator(
+        block_size=d, num_iter=30, lam=lam, mixture_weight=w, class_chunk=4
+    )
+    model = est.fit(jnp.asarray(a), jnp.asarray(y))  # grid → Woodbury
+    scale = max(np.abs(x_opt).max(), 1.0)
+    np.testing.assert_allclose(
+        np.asarray(model.xs[0]), x_opt, atol=5e-3 * scale
+    )
+    np.testing.assert_allclose(np.asarray(model.b), b_opt, atol=5e-3)
+
+    # equality vs the masked dense fallback (same math, dense solves)
+    xs, b = jax.jit(
+        lambda a_, y_: _weighted_bcd_fit(
+            a_, y_, None, None, None, d, 30, lam, w, 4
+        )
+    )(jnp.asarray(a), jnp.asarray(y))
+    np.testing.assert_allclose(
+        np.asarray(model.xs[0]), np.asarray(xs[0]), atol=1e-3 * scale
+    )
+    np.testing.assert_allclose(
+        np.asarray(model.b), np.asarray(b), atol=1e-3
+    )
+
+
 def test_weighted_matches_exact_optimum(rng):
     """The fixed point must equal the closed-form weighted-ridge optimum
     (per-column [A 1]ᵀW_c[A 1] system), incl. on imbalanced classes —

@@ -378,53 +378,82 @@ def validate_weighted_solver_scale(results):
     rng = np.random.default_rng(5)
 
     def run(n, d, block, c, chunk):
+        """Returns (per-pass seconds, one-fit seconds, data, y).
+
+        A fit call pays a one-time ~70ms host round trip (the grid
+        layout's class indices cross the axon tunnel before tracing), so
+        single-fit wall time is dominated by dispatch at these sizes.
+        Real fits run several BCD passes inside one jit — the steady-state
+        metric is the marginal cost of a pass: (t(3 passes) − t(1))/2.
+        """
         data = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
         labels_i = rng.integers(0, c, size=n).astype(np.int32)
         y = jnp.asarray(np.asarray(ClassLabelIndicators(num_classes=c)(labels_i)))
-        west = BlockWeightedLeastSquaresEstimator(
-            block_size=block,
-            num_iter=1,
-            lam=0.5,
-            mixture_weight=0.3,
-            class_chunk=chunk,
-        )
-        fitted = {}
+        times = {}
+        for iters in (1, 3):
+            west = BlockWeightedLeastSquaresEstimator(
+                block_size=block,
+                num_iter=iters,
+                lam=0.5,
+                mixture_weight=0.3,
+                class_chunk=chunk,
+            )
+            fitted = {}
 
-        def step():
-            fitted["model"] = west.fit(data, y, n_valid=n)
-            return fitted["model"]
+            def step(west=west, fitted=fitted):
+                fitted["model"] = west.fit(data, y, n_valid=n)
+                return fitted["model"]
 
-        t = _time(step, iters=3)
-        model = fitted["model"]
-        assert bool(jnp.isfinite(model.b).all()), "non-finite intercepts"
-        for x in model.xs:
-            assert bool(jnp.isfinite(x).all()), "non-finite model block"
-        return t, data, y
+            times[iters] = _time(step, iters=3)
+            model = fitted["model"]
+            assert bool(jnp.isfinite(model.b).all()), "non-finite intercepts"
+            for x in model.xs:
+                assert bool(jnp.isfinite(x).all()), "non-finite model block"
+        return max(times[3] - times[1], 0.0) / 2, times[1], data, y
 
     # (a) TIMIT shape: 147 classes, 2048 cols in 4 blocks
     n, d = 16384, 2048
-    t_w, data, y = run(n, d, 512, 147, 21)
-    est = BlockLeastSquaresEstimator(block_size=512, num_iter=1, lam=0.5)
+    t_w_pass, t_w_fit, data, y = run(n, d, 512, 147, 21)
     blocks = [data[:, i : i + 512] for i in range(0, d, 512)]
-    t_u = _time(lambda: est.fit(blocks, y, n_valid=n), iters=3)
+    ut = {}
+    for iters in (1, 3):
+        est = BlockLeastSquaresEstimator(
+            block_size=512, num_iter=iters, lam=0.5
+        )
+        ut[iters] = _time(
+            lambda est=est: est.fit(blocks, y, n_valid=n), iters=3
+        )
+    t_u_pass = max(ut[3] - ut[1], 0.0) / 2
+    # the unweighted fit sits near the dispatch floor: if timing noise
+    # makes the marginal pass cost ~0, report the ratio as unmeasurable
+    # rather than writing a nonsense number into the artifact
+    ratio = (
+        round(t_w_pass / t_u_pass, 2) if t_u_pass > 1e-3 else "unmeasurable"
+    )
     results["weighted_solver_timit_c147"] = {
         "n": n,
         "d": d,
         "classes": 147,
-        "weighted_ms": round(t_w * 1e3, 1),
-        "unweighted_ms": round(t_u * 1e3, 1),
-        "ratio": round(t_w / t_u, 2),
+        "weighted_ms_per_pass": round(t_w_pass * 1e3, 1),
+        "unweighted_ms_per_pass": round(t_u_pass * 1e3, 1),
+        "per_pass_ratio": ratio,
+        "weighted_one_fit_ms": round(t_w_fit * 1e3, 1),
+        "unweighted_one_fit_ms": round(ut[1] * 1e3, 1),
+        "note": "per-pass = (t(3 BCD passes) - t(1))/2; one-fit wall "
+        "time includes the one-time grid-layout host round trip "
+        "(~70ms axon tunnel) and dispatch floor",
     }
 
     # (b) ImageNet class count: C=1000, 4096 cols in 2 blocks of 2048
-    t_k, _, _ = run(16384, 4096, 2048, 1000, 8)
+    t_k_pass, t_k_fit, _, _ = run(16384, 4096, 2048, 1000, 8)
     results["weighted_solver_imagenet_c1000"] = {
         "n": 16384,
         "d": 4096,
         "classes": 1000,
-        "fit_ms": round(t_k * 1e3, 1),
-        "note": "feasibility: one full weighted-BCD pass, class-sorted "
-        "grid layout, chunked batched per-class solves",
+        "ms_per_pass": round(t_k_pass * 1e3, 1),
+        "one_fit_ms": round(t_k_fit * 1e3, 1),
+        "note": "feasibility: class-sorted grid layout + Woodbury "
+        "low-rank per-class solves (class_l+2 <= d_block/2)",
     }
 
 
@@ -474,6 +503,13 @@ def main() -> int:
     if os.environ.get("TPU_VALIDATE_LONG"):
         validate_long_context(results)
     out = REPO / "TPU_VALIDATION.json"
+    # merge-update: opt-in sections (e.g. the 32k long-context record)
+    # must survive runs that don't re-validate them
+    try:
+        prior = json.loads(out.read_text())
+    except Exception:  # noqa: BLE001 — first run / corrupt file
+        prior = {}
+    results = {**prior, **results}
     out.write_text(json.dumps(results, indent=2) + "\n")
     print(json.dumps(results, indent=2))
     print(f"\nall compiled-kernel validations passed -> {out}")
