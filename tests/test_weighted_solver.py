@@ -129,6 +129,30 @@ def test_weighted_predictions_favor_upweighted_class(rng):
     assert rare_recall(0.9) >= rare_recall(0.1)
 
 
+def test_woodbury_sharded_matches_local(rng, mesh8):
+    """Woodbury-active shape fitted from a sharded, padded batch must
+    match the local fit (B⁻¹ comes from the psum'd population covariance;
+    the grid gather crosses the data-axis sharding)."""
+    n, d, c = 401, 160, 8  # 401 pads to 408; L+2 = 66 <= 80 → Woodbury
+    a, y = _data(rng, n=n, d=d, c=c)
+    est = BlockWeightedLeastSquaresEstimator(
+        block_size=d, num_iter=6, lam=0.2, mixture_weight=0.4, class_chunk=4
+    )
+    m_local = est.fit(jnp.asarray(a), jnp.asarray(y))
+    m_shard = est.fit(
+        shard_batch(a, mesh8), shard_batch(y, mesh8), n_valid=n
+    )
+    scale = float(np.abs(np.asarray(m_local.xs[0])).max()) or 1.0
+    np.testing.assert_allclose(
+        np.asarray(m_shard.xs[0]),
+        np.asarray(m_local.xs[0]),
+        atol=2e-3 * scale,
+    )
+    np.testing.assert_allclose(
+        np.asarray(m_shard.b), np.asarray(m_local.b), atol=2e-3
+    )
+
+
 def test_woodbury_path_matches_exact_optimum(rng):
     """At wide blocks with small classes (class_l + 2 ≤ d_block/2) the grid
     layout switches the per-class solves to the Woodbury low-rank path —
