@@ -41,6 +41,17 @@ class TimitConfig:
     rf_type: str = arg(default="gaussian", choices=("gaussian", "cauchy"))
     lam: float = arg(default=0.0)
     num_epochs: int = arg(default=5)
+    checkpoint_dir: str = arg(
+        default="",
+        help="if set, checkpoint the solver between BCD epochs and "
+        "resume from this directory (reference setCheckpointDir, "
+        "TimitPipeline.scala:34,38)",
+    )
+    checkpoint_every: int = arg(
+        default=1,
+        help="BCD epochs per checkpoint chunk (higher amortizes the "
+        "per-chunk Gram recomputation)",
+    )
     seed: int = arg(default=123)
     synthetic: int = arg(default=0, help="if > 0, N synthetic frames")
 
@@ -104,9 +115,23 @@ def run(conf: TimitConfig, mesh=None) -> dict:
     est = BlockLeastSquaresEstimator(
         block_size=conf.cosine_features, num_iter=conf.num_epochs, lam=conf.lam
     )
-    model = jax.block_until_ready(
-        est.fit(train_blocks, indicators, n_valid=n_train)
-    )
+    if conf.checkpoint_dir:
+        from keystone_tpu.core.checkpoint import resumable_fit
+
+        model = jax.block_until_ready(
+            resumable_fit(
+                est,
+                train_blocks,
+                indicators,
+                checkpoint_dir=conf.checkpoint_dir,
+                every=conf.checkpoint_every,
+                n_valid=n_train,
+            )
+        )
+    else:
+        model = jax.block_until_ready(
+            est.fit(train_blocks, indicators, n_valid=n_train)
+        )
     t_fit = time.perf_counter()
 
     classify = MaxClassifier()

@@ -248,10 +248,21 @@ class BlockLeastSquaresEstimator(LabelEstimator):
     lam: float = static_field(default=0.0)
     num_features: int | None = static_field(default=None)
 
-    def fit(self, data, labels, n_valid: int | None = None) -> BlockLinearMapper:
+    def fit(
+        self,
+        data,
+        labels,
+        n_valid: int | None = None,
+        init: BlockLinearMapper | None = None,
+    ) -> BlockLinearMapper:
+        """``init`` warm-starts BCD from a previously fitted model's
+        blocks — the fixed point is identical, and k passes from a model
+        checkpointed after j passes equal one (j+k)-pass fit exactly (see
+        :func:`keystone_tpu.core.checkpoint.resumable_fit`)."""
         blocks = _split_blocks(data, self.block_size)
+        init_xs = None if init is None else tuple(init.xs)
         xs, means, intercept = _bcd_fit(
-            tuple(blocks), labels, n_valid, self.num_iter, self.lam
+            tuple(blocks), labels, n_valid, init_xs, self.num_iter, self.lam
         )
         return BlockLinearMapper(
             xs=xs, b=intercept, means=means, block_size=self.block_size
@@ -259,14 +270,15 @@ class BlockLeastSquaresEstimator(LabelEstimator):
 
 
 @partial(jax.jit, static_argnames=("num_iter", "lam"))
-def _bcd_fit(blocks: tuple, labels, n_valid, num_iter: int, lam: float):
+def _bcd_fit(
+    blocks: tuple, labels, n_valid, init_xs, num_iter: int, lam: float
+):
     dtype = blocks[0].dtype
     n_rows = blocks[0].shape[0]
     mask = _row_mask(n_rows, n_valid, dtype)
     n = jnp.sum(mask)
 
     b_mean = jnp.sum(labels * mask, axis=0) / n
-    resid = (labels - b_mean) * mask  # R = b_c − Σ A_i x_i, starts at b_c
 
     means, centered, grams = [], [], []
     for blk in blocks:
@@ -277,7 +289,15 @@ def _bcd_fit(blocks: tuple, labels, n_valid, num_iter: int, lam: float):
         grams.append(a_c.T @ a_c)  # contraction over sharded axis → psum
 
     k = labels.shape[-1]
-    xs = [jnp.zeros((blk.shape[-1], k), dtype) for blk in blocks]
+    if init_xs is None:
+        xs = [jnp.zeros((blk.shape[-1], k), dtype) for blk in blocks]
+    else:
+        xs = [x.astype(dtype) for x in init_xs]
+    # residual consistent with the (possibly warm-started) model:
+    # R = b_c − Σ A_i x_i
+    resid = (labels - b_mean) * mask
+    for a_c, x in zip(centered, xs):
+        resid = resid - a_c @ x
 
     for _ in range(num_iter):
         for i, a_c in enumerate(centered):

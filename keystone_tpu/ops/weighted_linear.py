@@ -59,7 +59,13 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
     mixture_weight: float = static_field(default=0.5)
     class_chunk: int = static_field(default=16)
 
-    def fit(self, data, labels, n_valid: int | None = None) -> BlockLinearMapper:
+    def fit(
+        self,
+        data,
+        labels,
+        n_valid: int | None = None,
+        init: BlockLinearMapper | None = None,
+    ) -> BlockLinearMapper:
         # The sorted fast path needs concrete, host-fetchable labels:
         # traced (fit under an outer jit) or multi-host non-addressable
         # arrays take the masked-segment path — correct anywhere, at
@@ -98,6 +104,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
             self.lam,
             self.mixture_weight,
             min(self.class_chunk, labels.shape[-1]),
+            init_xs=None if init is None else tuple(init.xs),
         )
         return BlockLinearMapper(
             xs=xs, b=b, means=None, block_size=self.block_size
@@ -152,6 +159,7 @@ def _weighted_bcd_fit(
     lam: float,
     mixture_weight: float,
     class_chunk: int,
+    init_xs=None,
 ):
     """Weighted BCD body. ``class_l`` non-None means ``sort_idx`` lays the
     rows out as a class-sorted (C, class_l) grid (grid row r belongs to
@@ -246,6 +254,13 @@ def _weighted_bcd_fit(
         return jnp.pad(x, pad)
 
     xs = tuple(jnp.zeros((a.shape[-1], c), dtype) for a in blocks)
+    if init_xs is not None:
+        # warm start (checkpoint resume): adopt the model and put the
+        # residual in the consistent state R = (labels − mean) − Σ A_i x_i
+        xs = tuple(x.astype(dtype) for x in init_xs)
+        for blk_a, x in zip(blocks, xs):
+            resid = resid - (blk_a * mask) @ x
+        res_mean = residual_mean(resid)
 
     def chunk_rhs(s):
         joint_xtr = (
