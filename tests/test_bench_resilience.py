@@ -53,6 +53,7 @@ def test_probe_failure_falls_back_inline(monkeypatch, capsys):
             "step_ms": 1.0,
             "solver_gflops": 1.0,
             "solver_tflops_per_s": 0.001,
+            "e2e_tflops_per_s": 0.002,
         }
 
     monkeypatch.setattr(bench, "bench_mnist", fake_mnist)
