@@ -43,6 +43,30 @@ def _manager(checkpoint_dir: str):
     )
 
 
+def checkpointed_fit(
+    est,
+    data,
+    labels,
+    *,
+    checkpoint_dir: str = "",
+    every: int = 1,
+    n_valid: int | None = None,
+):
+    """Model-CLI convenience: ``resumable_fit`` when ``checkpoint_dir`` is
+    set, plain ``est.fit`` otherwise (the shared wiring behind the
+    ``--checkpoint-dir``/``--checkpoint-every`` flags)."""
+    if checkpoint_dir:
+        return resumable_fit(
+            est,
+            data,
+            labels,
+            checkpoint_dir=checkpoint_dir,
+            every=every,
+            n_valid=n_valid,
+        )
+    return est.fit(data, labels, n_valid=n_valid)
+
+
 def resumable_fit(
     est,
     data,

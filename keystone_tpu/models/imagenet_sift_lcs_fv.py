@@ -56,6 +56,12 @@ class ImageNetConfig:
     lcs_stride: int = arg(default=4)
     lcs_border: int = arg(default=16)
     lcs_patch: int = arg(default=6)
+    checkpoint_dir: str = arg(
+        default="",
+        help="if set, checkpoint the weighted solver between BCD passes "
+        "and resume from this directory",
+    )
+    checkpoint_every: int = arg(default=1)
     seed: int = arg(default=0)
     synthetic: int = arg(default=0, help="if > 0, N synthetic images")
     synthetic_classes: int = arg(default=8)
@@ -305,8 +311,17 @@ def run_streaming(
         mixture_weight=conf.mixture_weight,
         class_chunk=min(16, num_classes),
     )
+    from keystone_tpu.core.checkpoint import checkpointed_fit
+
     model = jax.block_until_ready(
-        est.fit(f_train, indicators, n_valid=n_train)
+        checkpointed_fit(
+            est,
+            f_train,
+            indicators,
+            checkpoint_dir=conf.checkpoint_dir,
+            every=conf.checkpoint_every,
+            n_valid=n_train,
+        )
     )
     t_fit = time.perf_counter()
 
@@ -427,8 +442,17 @@ def run(conf: ImageNetConfig, mesh=None) -> dict:
         mixture_weight=conf.mixture_weight,
         class_chunk=min(16, num_classes),
     )
+    from keystone_tpu.core.checkpoint import checkpointed_fit
+
     model = jax.block_until_ready(
-        est.fit(f_train, indicators, n_valid=n_train)
+        checkpointed_fit(
+            est,
+            f_train,
+            indicators,
+            checkpoint_dir=conf.checkpoint_dir,
+            every=conf.checkpoint_every,
+            n_valid=n_train,
+        )
     )
     t_fit = time.perf_counter()
 

@@ -115,23 +115,18 @@ def run(conf: TimitConfig, mesh=None) -> dict:
     est = BlockLeastSquaresEstimator(
         block_size=conf.cosine_features, num_iter=conf.num_epochs, lam=conf.lam
     )
-    if conf.checkpoint_dir:
-        from keystone_tpu.core.checkpoint import resumable_fit
+    from keystone_tpu.core.checkpoint import checkpointed_fit
 
-        model = jax.block_until_ready(
-            resumable_fit(
-                est,
-                train_blocks,
-                indicators,
-                checkpoint_dir=conf.checkpoint_dir,
-                every=conf.checkpoint_every,
-                n_valid=n_train,
-            )
+    model = jax.block_until_ready(
+        checkpointed_fit(
+            est,
+            train_blocks,
+            indicators,
+            checkpoint_dir=conf.checkpoint_dir,
+            every=conf.checkpoint_every,
+            n_valid=n_train,
         )
-    else:
-        model = jax.block_until_ready(
-            est.fit(train_blocks, indicators, n_valid=n_train)
-        )
+    )
     t_fit = time.perf_counter()
 
     classify = MaxClassifier()
