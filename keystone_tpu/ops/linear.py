@@ -81,6 +81,17 @@ def ridge_solve(
     return x
 
 
+def _matmul_precision(precision: str | None):
+    """Context for an estimator-level matmul-precision override; no-op
+    when unset (the jit cache keys on the config state, so fits at
+    different precisions don't collide)."""
+    import contextlib
+
+    if precision is None:
+        return contextlib.nullcontext()
+    return jax.default_matmul_precision(precision)
+
+
 def stabilized_cho_solve(mat: jnp.ndarray, jitter: float = 1e-6):
     """Factor a symmetric PSD ``mat`` once, return a multi-RHS solver.
 
@@ -247,6 +258,11 @@ class BlockLeastSquaresEstimator(LabelEstimator):
     num_iter: int = static_field(default=1)
     lam: float = static_field(default=0.0)
     num_features: int | None = static_field(default=None)
+    # Gram/solve matmul precision: None = backend default (bf16 MXU
+    # passes on TPU; the equilibrated+refined ridge_solve is built for
+    # this), "highest" = full f32 accumulation (reference-BLAS class) —
+    # same contract as Convolver.precision
+    precision: str | None = static_field(default=None)
 
     def fit(
         self,
@@ -261,9 +277,15 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         :func:`keystone_tpu.core.checkpoint.resumable_fit`)."""
         blocks = _split_blocks(data, self.block_size)
         init_xs = None if init is None else tuple(init.xs)
-        xs, means, intercept = _bcd_fit(
-            tuple(blocks), labels, n_valid, init_xs, self.num_iter, self.lam
-        )
+        with _matmul_precision(self.precision):
+            xs, means, intercept = _bcd_fit(
+                tuple(blocks),
+                labels,
+                n_valid,
+                init_xs,
+                self.num_iter,
+                self.lam,
+            )
         return BlockLinearMapper(
             xs=xs, b=intercept, means=means, block_size=self.block_size
         )

@@ -41,7 +41,13 @@ import numpy as np
 
 from keystone_tpu.core.pipeline import LabelEstimator
 from keystone_tpu.core.treenode import static_field, treenode
-from keystone_tpu.ops.linear import BlockLinearMapper, _row_mask, _split_blocks, ridge_solve
+from keystone_tpu.ops.linear import (
+    BlockLinearMapper,
+    _matmul_precision,
+    _row_mask,
+    _split_blocks,
+    ridge_solve,
+)
 
 
 @treenode
@@ -58,6 +64,9 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
     lam: float = static_field(default=0.0)
     mixture_weight: float = static_field(default=0.5)
     class_chunk: int = static_field(default=16)
+    # matmul precision for Grams/solves: None = backend default (bf16 MXU
+    # passes), "highest" = full f32 (reference-BLAS class)
+    precision: str | None = static_field(default=None)
 
     def fit(
         self,
@@ -93,19 +102,20 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
             )
             if perm is not None:  # None: too imbalanced, grid would blow up
                 sort_idx, class_l = perm.reshape(-1), perm.shape[1]
-        xs, b = _weighted_bcd_fit(
-            data,
-            labels,
-            sort_idx,
-            n_valid,
-            class_l,
-            self.block_size,
-            self.num_iter,
-            self.lam,
-            self.mixture_weight,
-            min(self.class_chunk, labels.shape[-1]),
-            init_xs=None if init is None else tuple(init.xs),
-        )
+        with _matmul_precision(self.precision):
+            xs, b = _weighted_bcd_fit(
+                data,
+                labels,
+                sort_idx,
+                n_valid,
+                class_l,
+                self.block_size,
+                self.num_iter,
+                self.lam,
+                self.mixture_weight,
+                min(self.class_chunk, labels.shape[-1]),
+                init_xs=None if init is None else tuple(init.xs),
+            )
         return BlockLinearMapper(
             xs=xs, b=b, means=None, block_size=self.block_size
         )
