@@ -211,6 +211,30 @@ def validate_flash_attention(results):
     }
     assert err < 5e-2, f"flash bf16: err {err}"
 
+    # --- throughput shape: the small entries above sit on the shared
+    # chip's ~7ms dispatch floor and say nothing about kernel rate; this
+    # one is big enough (~0.27 TFLOP causal) to read TFLOP/s off ---
+    b, h, s, d = 4, 16, 4096, 128
+    q = jnp.asarray(rng.normal(size=(b, h, s, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, h, s, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, h, s, d)), jnp.float32)
+    fl = jax.jit(
+        lambda q, k, v: flash_attention(q, k, v, causal=True, interpret=False)
+    )
+    ref = jax.jit(lambda q, k, v: dense_attention(q, k, v, causal=True))
+    t_ref, t_fl = _time(ref, q, k, v, iters=4), _time(fl, q, k, v, iters=4)
+    err_rel = _max_err(fl(q, k, v), ref(q, k, v))
+    flops = 4 * b * h * s * s * d / 2  # causal half
+    results["flash_throughput_4x16x4096x128"] = {
+        "shape": [b, h, s, d],
+        "jnp_ms": round(t_ref * 1e3, 3),
+        "pallas_ms": round(t_fl * 1e3, 3),
+        "speedup": round(t_ref / t_fl, 2),
+        "pallas_tflops_per_s": round(flops / t_fl / 1e12, 2),
+        "max_err_vs_jnp": err_rel,
+    }
+    assert err_rel < 5e-2, f"flash throughput shape: err {err_rel}"
+
 
 def validate_flash_step(results):
     """Chain flash_attention_step over hops == ring attention's inner loop."""
