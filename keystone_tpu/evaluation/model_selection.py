@@ -1,0 +1,59 @@
+"""Ridge-path model selection over a λ sweep.
+
+The reference's solver engine accepted an array of lambdas precisely so
+pipelines could sweep regularization while reusing the normal-equation
+statistics (mlmatrix ``solveLeastSquaresWithL2(A, b, Array(lambda), ..)``;
+the KeystoneML paper leans on this for model search). Here
+:meth:`BlockLeastSquaresEstimator.fit_sweep` batches the solves over λ on
+the sweep axis, and :func:`select_lambda` scores each fitted model on
+held-out data and returns the winner.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from keystone_tpu.evaluation.multiclass import MulticlassClassifierEvaluator
+from keystone_tpu.ops.util import MaxClassifier
+
+
+def select_lambda(
+    est,
+    train_inputs,
+    train_indicators,
+    lams,
+    val_inputs,
+    val_label_idx,
+    *,
+    num_classes: int,
+    n_valid: int | None = None,
+    n_valid_val: int | None = None,
+):
+    """Fit one model per λ (shared Grams) and pick the best by held-out
+    multiclass error.
+
+    ``train_inputs``/``val_inputs`` are whatever the estimator/model
+    consume (a feature matrix or a list of feature blocks);
+    ``val_label_idx`` are integer class labels for the held-out rows.
+    Returns ``(best_model, report)`` where report lists per-λ errors.
+    """
+    models = est.fit_sweep(
+        train_inputs, train_indicators, lams, n_valid=n_valid
+    )
+    classify = MaxClassifier()
+    evaluator = MulticlassClassifierEvaluator(num_classes)
+    errors = [
+        float(
+            evaluator(
+                classify(m(val_inputs)), val_label_idx, n_valid=n_valid_val
+            ).error
+        )
+        for m in models
+    ]
+    best = int(np.argmin(errors))
+    return models[best], {
+        "lams": [float(l) for l in lams],
+        "val_errors": errors,
+        "best_lam": float(lams[best]),
+        "best_error": errors[best],
+    }

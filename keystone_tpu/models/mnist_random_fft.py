@@ -52,6 +52,12 @@ class MnistRandomFFTConfig:
     num_ffts: int = arg(default=200, help="number of random FFT draws")
     block_size: int = arg(default=2048, help="solver block size (multiple of 512)")
     lam: float = arg(default=0.0, help="L2 regularization")
+    lam_sweep: str = arg(
+        default="",
+        help="comma-separated λ list: fit the whole ridge path at shared-"
+        "Gram cost, pick the best on a held-out 10%% of train, refit on "
+        "all of train at that λ (overrides --lam)",
+    )
     seed: int = arg(default=0)
     synthetic: int = arg(
         default=0, help="if > 0, run on N synthetic samples instead of csvs"
@@ -138,8 +144,43 @@ def run(conf: MnistRandomFFTConfig, mesh=None) -> dict:
     train_blocks = jax.block_until_ready(featurize(batch_featurizers, train_x))
     t_feat = time.perf_counter()
 
+    lam = conf.lam
+    if conf.lam_sweep:
+        from keystone_tpu.evaluation.model_selection import select_lambda
+
+        lams = [float(x) for x in conf.lam_sweep.split(",") if x.strip()]
+        if n_train < 20:
+            raise SystemExit(
+                "--lam-sweep holds out 10% of train for selection; "
+                f"need at least 20 training rows, got {n_train}"
+            )
+        # hold out the last 10% of train rows for selection (padded rows
+        # already sit past n_train, so validity masks stay prefix-shaped)
+        n_fit = max(n_train - n_train // 10, 1)
+        val_blocks = [b[n_fit:] for b in train_blocks]
+        val_y = train_y[n_fit:n_train] if n_train > n_fit else train_y[:0]
+        _, report = select_lambda(
+            BlockLeastSquaresEstimator(
+                block_size=conf.block_size, num_iter=1
+            ),
+            train_blocks,
+            label_indicators,
+            lams,
+            val_blocks,
+            np.pad(val_y, (0, val_blocks[0].shape[0] - len(val_y))),
+            num_classes=NUM_CLASSES,
+            n_valid=n_fit,
+            n_valid_val=len(val_y),
+        )
+        lam = report["best_lam"]
+        logger.info(
+            "lambda sweep %s -> val errors %s; refitting at best lam=%g",
+            report["lams"],
+            [round(e, 4) for e in report["val_errors"]],
+            lam,
+        )
     est = BlockLeastSquaresEstimator(
-        block_size=conf.block_size, num_iter=1, lam=conf.lam
+        block_size=conf.block_size, num_iter=1, lam=lam
     )
     model = jax.block_until_ready(
         est.fit(train_blocks, label_indicators, n_valid=n_train)

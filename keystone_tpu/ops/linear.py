@@ -291,17 +291,45 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         )
 
 
-@partial(jax.jit, static_argnames=("num_iter", "lam"))
-def _bcd_fit(
-    blocks: tuple, labels, n_valid, init_xs, num_iter: int, lam: float
-):
+    def fit_sweep(
+        self, data, labels, lams, n_valid: int | None = None
+    ) -> list[BlockLinearMapper]:
+        """Fit one model per ridge λ in ``lams`` at marginal cost.
+
+        The reference's solver engine took an ARRAY of lambdas
+        (mlmatrix ``solveLeastSquaresWithL2(A, b, Array(lambda), ...)``,
+        BlockLinearMapper.scala:178-181) so hyperparameter sweeps could
+        reuse the expensive normal-equation statistics; same here: the
+        per-block Grams (the N·d² work) are computed once and the
+        per-λ solves/residuals are batched (vmapped) over the sweep —
+        an L-point sweep costs far less than L fits. Returns models in
+        ``lams`` order.
+        """
+        blocks = _split_blocks(data, self.block_size)
+        lams_arr = jnp.asarray(lams, jnp.float32)
+        with _matmul_precision(self.precision):
+            xs_l, means, intercept = _bcd_fit_sweep(
+                tuple(blocks), labels, n_valid, lams_arr, self.num_iter
+            )
+        return [
+            BlockLinearMapper(
+                xs=tuple(xb[i] for xb in xs_l),
+                b=intercept,
+                means=means,
+                block_size=self.block_size,
+            )
+            for i in range(lams_arr.shape[0])
+        ]
+
+
+def _block_stats(blocks: tuple, labels, n_valid):
+    """Shared BCD preamble: row mask, label mean, per-block means,
+    centered blocks, and Grams (the N·d² statistics both the single-λ fit
+    and the λ-sweep reuse)."""
     dtype = blocks[0].dtype
-    n_rows = blocks[0].shape[0]
-    mask = _row_mask(n_rows, n_valid, dtype)
+    mask = _row_mask(blocks[0].shape[0], n_valid, dtype)
     n = jnp.sum(mask)
-
     b_mean = jnp.sum(labels * mask, axis=0) / n
-
     means, centered, grams = [], [], []
     for blk in blocks:
         m = jnp.sum(blk * mask, axis=0) / n
@@ -309,6 +337,54 @@ def _bcd_fit(
         means.append(m)
         centered.append(a_c)
         grams.append(a_c.T @ a_c)  # contraction over sharded axis → psum
+    return mask, b_mean, means, centered, grams
+
+
+@partial(jax.jit, static_argnames=("num_iter",))
+def _bcd_fit_sweep(blocks: tuple, labels, n_valid, lams, num_iter: int):
+    """Multi-λ BCD: shared Grams, λ-batched solves. xs per block come back
+    with a leading sweep axis (L, d_block, C)."""
+    dtype = blocks[0].dtype
+    lams = lams.astype(dtype)  # keep the fori_loop carry dtype-stable
+    mask, b_mean, means, centered, grams = _block_stats(
+        blocks, labels, n_valid
+    )
+
+    k = labels.shape[-1]
+    n_lam = lams.shape[0]
+    xs = tuple(
+        jnp.zeros((n_lam, blk.shape[-1], k), dtype) for blk in blocks
+    )
+    resid = jnp.broadcast_to(
+        (labels - b_mean) * mask, (n_lam,) + labels.shape
+    ).astype(dtype)
+
+    def one_pass(_p, state):
+        xs, resid = state
+        xs = list(xs)
+        for i, a_c in enumerate(centered):
+            rhs = jnp.einsum("nd,lnc->ldc", a_c, resid) + jnp.einsum(
+                "de,lec->ldc", grams[i], xs[i]
+            )
+            x_new = jax.vmap(
+                lambda r, l, g=grams[i]: ridge_solve(g, r, l)
+            )(rhs, lams)
+            resid = resid - jnp.einsum("nd,ldc->lnc", a_c, x_new - xs[i])
+            xs[i] = x_new
+        return tuple(xs), resid
+
+    xs, resid = jax.lax.fori_loop(0, num_iter, one_pass, (xs, resid))
+    return xs, tuple(means), b_mean
+
+
+@partial(jax.jit, static_argnames=("num_iter", "lam"))
+def _bcd_fit(
+    blocks: tuple, labels, n_valid, init_xs, num_iter: int, lam: float
+):
+    dtype = blocks[0].dtype
+    mask, b_mean, means, centered, grams = _block_stats(
+        blocks, labels, n_valid
+    )
 
     k = labels.shape[-1]
     if init_xs is None:

@@ -221,17 +221,23 @@ def validate_flash_attention(results):
     fl = jax.jit(
         lambda q, k, v: flash_attention(q, k, v, causal=True, interpret=False)
     )
-    ref = jax.jit(lambda q, k, v: dense_attention(q, k, v, causal=True))
-    t_ref, t_fl = _time(ref, q, k, v, iters=4), _time(fl, q, k, v, iters=4)
-    err_rel = _max_err(fl(q, k, v), ref(q, k, v))
+    # numerics gate on a one-head slice: the full dense reference would
+    # materialize a (4,16,4096,4096) logits tensor (~4.3GB + softmax
+    # copies) and can OOM the shared chip; flash itself needs no such
+    # buffer — that's the point
+    ref1 = jax.jit(lambda q, k, v: dense_attention(q, k, v, causal=True))
+    err_rel = _max_err(
+        fl(q, k, v)[:1, :1], ref1(q[:1, :1], k[:1, :1], v[:1, :1])
+    )
+    t_fl = _time(fl, q, k, v, iters=4)
     flops = 4 * b * h * s * s * d / 2  # causal half
     results["flash_throughput_4x16x4096x128"] = {
         "shape": [b, h, s, d],
-        "jnp_ms": round(t_ref * 1e3, 3),
         "pallas_ms": round(t_fl * 1e3, 3),
-        "speedup": round(t_ref / t_fl, 2),
         "pallas_tflops_per_s": round(flops / t_fl / 1e12, 2),
-        "max_err_vs_jnp": err_rel,
+        "max_err_vs_jnp_slice": err_rel,
+        "dense_jnp": "not timed: (B,H,S,S) logits ~4.3GB risks OOM on "
+        "the shared chip",
     }
     assert err_rel < 5e-2, f"flash throughput shape: err {err_rel}"
 
