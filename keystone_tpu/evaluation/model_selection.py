@@ -57,3 +57,60 @@ def select_lambda(
         "best_lam": float(lams[best]),
         "best_error": errors[best],
     }
+
+
+def holdout_lambda_sweep(
+    est,
+    train_inputs,
+    train_indicators,
+    train_label_idx,
+    lams,
+    *,
+    n_train: int,
+    num_classes: int,
+    holdout_frac: float = 0.1,
+):
+    """λ selection on a held-out suffix of the training rows.
+
+    Fits the sweep on the first ``1 − holdout_frac`` of the valid rows
+    (padded rows already sit past ``n_train``, so validity masks stay
+    prefix-shaped) and scores each λ on the held-out tail. Returns the
+    report dict (``best_lam``, per-λ ``val_errors``); callers refit on
+    the full training set at ``best_lam``. The shared wiring behind the
+    model CLIs' ``--lam-sweep`` flag — ``lams`` may be the raw
+    comma-separated flag string or a sequence of floats.
+    """
+    if isinstance(lams, str):
+        lams = [float(x) for x in lams.split(",") if x.strip()]
+    lams = list(lams)
+    if not lams:
+        raise ValueError(
+            "lambda sweep got no values — pass e.g. "
+            '--lam-sweep "1e-3,1e-2,1e-1"'
+        )
+    n_hold = int(n_train * holdout_frac)
+    if n_hold < 1:
+        raise ValueError(
+            f"lambda sweep holdout is empty: n_train={n_train} at "
+            f"holdout_frac={holdout_frac} leaves no validation rows"
+        )
+    n_fit = n_train - n_hold
+    if isinstance(train_inputs, (list, tuple)):
+        val_inputs = [b[n_fit:] for b in train_inputs]
+        pad_rows = val_inputs[0].shape[0]
+    else:
+        val_inputs = train_inputs[n_fit:]
+        pad_rows = val_inputs.shape[0]
+    val_y = np.asarray(train_label_idx[n_fit:n_train], np.int32)
+    _, report = select_lambda(
+        est,
+        train_inputs,
+        train_indicators,
+        lams,
+        val_inputs,
+        np.pad(val_y, (0, pad_rows - len(val_y))),
+        num_classes=num_classes,
+        n_valid=n_fit,
+        n_valid_val=len(val_y),
+    )
+    return report

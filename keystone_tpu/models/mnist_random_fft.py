@@ -146,31 +146,20 @@ def run(conf: MnistRandomFFTConfig, mesh=None) -> dict:
 
     lam = conf.lam
     if conf.lam_sweep:
-        from keystone_tpu.evaluation.model_selection import select_lambda
+        from keystone_tpu.evaluation.model_selection import (
+            holdout_lambda_sweep,
+        )
 
-        lams = [float(x) for x in conf.lam_sweep.split(",") if x.strip()]
-        if n_train < 20:
-            raise SystemExit(
-                "--lam-sweep holds out 10% of train for selection; "
-                f"need at least 20 training rows, got {n_train}"
-            )
-        # hold out the last 10% of train rows for selection (padded rows
-        # already sit past n_train, so validity masks stay prefix-shaped)
-        n_fit = max(n_train - n_train // 10, 1)
-        val_blocks = [b[n_fit:] for b in train_blocks]
-        val_y = train_y[n_fit:n_train] if n_train > n_fit else train_y[:0]
-        _, report = select_lambda(
+        report = holdout_lambda_sweep(
             BlockLeastSquaresEstimator(
                 block_size=conf.block_size, num_iter=1
             ),
             train_blocks,
             label_indicators,
-            lams,
-            val_blocks,
-            np.pad(val_y, (0, val_blocks[0].shape[0] - len(val_y))),
+            train_y,
+            conf.lam_sweep,
+            n_train=n_train,
             num_classes=NUM_CLASSES,
-            n_valid=n_fit,
-            n_valid_val=len(val_y),
         )
         lam = report["best_lam"]
         logger.info(
