@@ -73,3 +73,40 @@ def test_main_runs_reference_class_name():
             "5",
         ]
     )
+
+
+def test_save_load_fused_and_sweep_models(tmp_path, rng):
+    """New node types round-trip through save_pipeline: the fusion pass's
+    FusedConvRectifyPool and a fit_sweep model."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.core.fusion import optimize
+    from keystone_tpu.core.serialization import load_pipeline, save_pipeline
+    from keystone_tpu.ops.images import Convolver, Pooler, SymmetricRectifier
+    from keystone_tpu.ops.linear import BlockLeastSquaresEstimator
+
+    filters = jnp.asarray(rng.normal(size=(4, 27)).astype(np.float32))
+    pipe = optimize(
+        Convolver(filters=filters, patch_size=3)
+        >> SymmetricRectifier(alpha=0.1)
+        >> Pooler(stride=3, pool_size=4)
+    )
+    batch = jnp.asarray(rng.normal(size=(2, 10, 10, 3)).astype(np.float32))
+    p = str(tmp_path / "fused.kstp")
+    save_pipeline(pipe, p)
+    loaded = load_pipeline(p)
+    np.testing.assert_allclose(
+        np.asarray(loaded(batch)), np.asarray(pipe(batch)), atol=1e-6
+    )
+
+    a = jnp.asarray(rng.normal(size=(40, 8)).astype(np.float32))
+    y = jnp.asarray(rng.normal(size=(40, 2)).astype(np.float32))
+    model = BlockLeastSquaresEstimator(block_size=4, num_iter=2).fit_sweep(
+        a, y, [0.1, 1.0]
+    )[1]
+    p2 = str(tmp_path / "sweep.kstp")
+    save_pipeline(model, p2)
+    loaded2 = load_pipeline(p2)
+    np.testing.assert_allclose(
+        np.asarray(loaded2(a)), np.asarray(model(a)), atol=1e-6
+    )
