@@ -200,3 +200,25 @@ def test_select_lambda_picks_validation_argmin(rng):
     assert report["best_lam"] == lams[int(np.argmin(report["val_errors"]))]
     # the absurd λ=1e5 shrinks the model to ~0: it must not win
     assert report["best_lam"] != 1e5
+
+
+def test_fit_sweep_sharded_matches_local(rng, mesh8):
+    """λ-sweep fits from a sharded, padded batch must match local fits
+    (the shared Grams contract over the data-axis psum)."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.parallel.mesh import shard_batch
+
+    a = rng.normal(size=(61, 10)).astype(np.float32)  # pads to 64
+    y = rng.normal(size=(61, 2)).astype(np.float32)
+    est = BlockLeastSquaresEstimator(block_size=5, num_iter=2)
+    lams = [0.05, 2.0]
+    local = est.fit_sweep(jnp.asarray(a), jnp.asarray(y), lams)
+    sharded = est.fit_sweep(
+        shard_batch(a, mesh8), shard_batch(y, mesh8), lams, n_valid=len(a)
+    )
+    for ml, ms in zip(local, sharded):
+        for x1, x2 in zip(ml.xs, ms.xs):
+            np.testing.assert_allclose(
+                np.asarray(x2), np.asarray(x1), atol=1e-4
+            )
