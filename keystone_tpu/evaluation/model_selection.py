@@ -50,12 +50,18 @@ def select_lambda(
         )
         for m in models
     ]
-    best = int(np.argmin(errors))
-    return models[best], {
+    best, report = _pick_best(lams, errors)
+    return models[best], report
+
+
+def _pick_best(lams, losses):
+    """argmin selection + the report dict shared by every sweep path."""
+    best = int(np.argmin(losses))
+    return best, {
         "lams": [float(l) for l in lams],
-        "val_errors": errors,
+        "val_errors": [float(e) for e in losses],
         "best_lam": float(lams[best]),
-        "best_error": errors[best],
+        "best_error": float(losses[best]),
     }
 
 
@@ -67,8 +73,9 @@ def holdout_lambda_sweep(
     lams,
     *,
     n_train: int,
-    num_classes: int,
+    num_classes: int | None = None,
     holdout_frac: float = 0.1,
+    scorer=None,
 ):
     """λ selection on a held-out suffix of the training rows.
 
@@ -79,6 +86,12 @@ def holdout_lambda_sweep(
     the full training set at ``best_lam``. The shared wiring behind the
     model CLIs' ``--lam-sweep`` flag — ``lams`` may be the raw
     comma-separated flag string or a sequence of floats.
+
+    Default scoring is multiclass error on ``train_label_idx`` (requires
+    ``num_classes``). Other metrics pass ``scorer(model, val_inputs,
+    (lo, hi)) -> loss`` (lower = better; ``lo:hi`` is the held-out row
+    range of the original training arrays) — e.g. VOC scores −MAP over
+    multi-label indicators.
     """
     if isinstance(lams, str):
         lams = [float(x) for x in lams.split(",") if x.strip()]
@@ -101,6 +114,17 @@ def holdout_lambda_sweep(
     else:
         val_inputs = train_inputs[n_fit:]
         pad_rows = val_inputs.shape[0]
+    if scorer is not None:
+        models = est.fit_sweep(
+            train_inputs, train_indicators, lams, n_valid=n_fit
+        )
+        losses = [
+            float(scorer(m, val_inputs, (n_fit, n_train))) for m in models
+        ]
+        _, report = _pick_best(lams, losses)
+        return report
+    if num_classes is None:
+        raise ValueError("num_classes is required for the default scorer")
     val_y = np.asarray(train_label_idx[n_fit:n_train], np.int32)
     _, report = select_lambda(
         est,

@@ -56,6 +56,12 @@ class VOCConfig:
     num_pca_samples: int = arg(default=1_000_000)
     num_gmm_samples: int = arg(default=1_000_000)
     lam: float = arg(default=0.5)
+    lam_sweep: str = arg(
+        default="",
+        help="comma-separated λ list: ridge path at shared-Gram cost, "
+        "selected by mean-AP on a held-out 10%% of train (overrides "
+        "--lam)",
+    )
     block_size: int = arg(default=4096)
     chunk_size: int = arg(default=64, help="images per featurize chunk")
     image_size: int = arg(default=256)
@@ -133,8 +139,40 @@ def run(conf: VOCConfig, mesh=None) -> dict:
     indicators = ClassLabelIndicators(num_classes=VOC_NUM_CLASSES)(
         jnp.asarray(y)
     )
+    lam = conf.lam
+    if conf.lam_sweep:
+        from keystone_tpu.evaluation.model_selection import (
+            holdout_lambda_sweep,
+        )
+
+        sweep_eval = MeanAveragePrecisionEvaluator(VOC_NUM_CLASSES)
+
+        def map_scorer(model, val_inputs, rows):
+            lo, hi = rows
+            scores = np.asarray(model(val_inputs))[: hi - lo]
+            aps = sweep_eval(np.asarray(indicators)[lo:hi], scores)
+            return -float(np.mean(aps))  # lower loss = higher MAP
+
+        report = holdout_lambda_sweep(
+            BlockLeastSquaresEstimator(
+                block_size=conf.block_size, num_iter=1
+            ),
+            f_train,
+            indicators,
+            None,
+            conf.lam_sweep,
+            n_train=n_train,
+            scorer=map_scorer,
+        )
+        lam = report["best_lam"]
+        logger.info(
+            "lambda sweep %s -> val -MAP %s; refitting at best lam=%g",
+            report["lams"],
+            [round(e, 4) for e in report["val_errors"]],
+            lam,
+        )
     model = BlockLeastSquaresEstimator(
-        block_size=conf.block_size, num_iter=1, lam=conf.lam
+        block_size=conf.block_size, num_iter=1, lam=lam
     ).fit(f_train, indicators, n_valid=n_train)
     t_fit = time.perf_counter()
 

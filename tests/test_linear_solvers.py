@@ -222,3 +222,38 @@ def test_fit_sweep_sharded_matches_local(rng, mesh8):
             np.testing.assert_allclose(
                 np.asarray(x2), np.asarray(x1), atol=1e-4
             )
+
+
+def test_holdout_sweep_custom_scorer(rng):
+    """holdout_lambda_sweep's scorer path: the (lo, hi) row range must
+    align with the sliced val inputs, and the λ minimizing the custom
+    loss must win."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.evaluation.model_selection import holdout_lambda_sweep
+
+    n, d = 100, 12
+    a = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = rng.normal(size=(d, 2)).astype(np.float32)
+    y = (a @ w_true + 0.05 * rng.normal(size=(n, 2))).astype(np.float32)
+    seen = {}
+
+    def mse_scorer(model, val_inputs, rows):
+        lo, hi = rows
+        seen["rows"] = rows
+        pred = np.asarray(model(val_inputs))[: hi - lo]
+        return float(((pred - y[lo:hi]) ** 2).mean())
+
+    report = holdout_lambda_sweep(
+        BlockLeastSquaresEstimator(block_size=d, num_iter=2),
+        jnp.asarray(a),
+        jnp.asarray(y),
+        None,
+        "0.01,1e6",
+        n_train=n,
+        scorer=mse_scorer,
+    )
+    assert seen["rows"] == (90, 100)
+    # absurd regularization must lose under the held-out MSE
+    assert report["best_lam"] == 0.01
+    assert report["val_errors"][0] < report["val_errors"][1]
