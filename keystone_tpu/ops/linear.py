@@ -159,9 +159,26 @@ class LinearMapEstimator(LabelEstimator):
         scaler = StandardScalerModel(mean=a_mean, std=None)
         return LinearMapper(x=x, b=b_mean, feature_scaler=scaler)
 
+    def fit_sweep(
+        self, data, labels, lams, n_valid: int | None = None
+    ) -> list[LinearMapper]:
+        """One exact ridge model per λ: the (N·d²) Gram is computed once,
+        the (d³) solves are vmapped over the sweep (mlmatrix's
+        ``Array(lambda)`` capability — see
+        ``BlockLeastSquaresEstimator.fit_sweep``)."""
+        lams_arr = jnp.asarray(lams)
+        xs, b_mean, a_mean = _linear_map_fit_sweep(
+            data, labels, n_valid, lams_arr
+        )
+        scaler = StandardScalerModel(mean=a_mean, std=None)
+        return [
+            LinearMapper(x=xs[i], b=b_mean, feature_scaler=scaler)
+            for i in range(lams_arr.shape[0])
+        ]
 
-@partial(jax.jit, static_argnames=("lam",))
-def _linear_map_fit(data, labels, n_valid, lam: float):
+
+def _normal_eq_stats(data, labels, n_valid):
+    """Shared preamble: masked means, centered Gram AᵀA and AᵀB."""
     dtype = data.dtype
     mask = _row_mask(data.shape[0], n_valid, dtype)
     n = jnp.sum(mask)
@@ -169,8 +186,22 @@ def _linear_map_fit(data, labels, n_valid, lam: float):
     b_mean = jnp.sum(labels * mask, axis=0) / n
     a_c = (data - a_mean) * mask
     b_c = (labels - b_mean) * mask
-    x = ridge_solve(a_c.T @ a_c, a_c.T @ b_c, lam)
+    return a_c.T @ a_c, a_c.T @ b_c, b_mean, a_mean
+
+
+@partial(jax.jit, static_argnames=("lam",))
+def _linear_map_fit(data, labels, n_valid, lam: float):
+    ata, atb, b_mean, a_mean = _normal_eq_stats(data, labels, n_valid)
+    x = ridge_solve(ata, atb, lam)
     return x, b_mean, a_mean
+
+
+@jax.jit
+def _linear_map_fit_sweep(data, labels, n_valid, lams):
+    ata, atb, b_mean, a_mean = _normal_eq_stats(data, labels, n_valid)
+    lams = lams.astype(data.dtype)
+    xs = jax.vmap(lambda l: ridge_solve(ata, atb, l))(lams)
+    return xs, b_mean, a_mean
 
 
 def _split_blocks(data, block_size: int) -> list:

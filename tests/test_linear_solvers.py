@@ -257,3 +257,18 @@ def test_holdout_sweep_custom_scorer(rng):
     # absurd regularization must lose under the held-out MSE
     assert report["best_lam"] == 0.01
     assert report["val_errors"][0] < report["val_errors"][1]
+
+
+def test_linear_map_fit_sweep_matches_individual(rng):
+    a = jnp.asarray(rng.normal(size=(50, 9)).astype(np.float32))
+    y = jnp.asarray(rng.normal(size=(50, 2)).astype(np.float32))
+    lams = [0.01, 1.0]
+    models = LinearMapEstimator().fit_sweep(a, y, lams)
+    for lam, m in zip(lams, models):
+        single = LinearMapEstimator(lam=lam).fit(a, y)
+        np.testing.assert_allclose(
+            np.asarray(m.x), np.asarray(single.x), atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(m(a)), np.asarray(single(a)), atol=1e-4
+        )
