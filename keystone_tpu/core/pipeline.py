@@ -30,7 +30,7 @@ Spark's lazy RDD DAG used to be.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Sequence
+from typing import Any, Callable
 
 import jax
 

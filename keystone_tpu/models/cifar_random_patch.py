@@ -30,7 +30,6 @@ from keystone_tpu.core.config import arg, parse_config
 from keystone_tpu.core.fusion import optimize
 from keystone_tpu.core.logging import get_logger
 from keystone_tpu.evaluation import MulticlassClassifierEvaluator
-from keystone_tpu.loaders.cifar import load_cifar
 from keystone_tpu.models.cifar_linear_pixels import _load as _load_cifar_or_synth
 from keystone_tpu.ops.images import (
     Convolver,

@@ -15,7 +15,6 @@ save/load as CSVs compatible with the reference's file formats.
 from __future__ import annotations
 
 import dataclasses
-import os
 import time
 
 import jax

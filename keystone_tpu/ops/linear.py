@@ -22,9 +22,8 @@ Padding: batches zero-padded for sharding (``parallel.mesh.pad_batch``) pass
 
 from __future__ import annotations
 
-import math
 from functools import partial
-from typing import Callable, Sequence
+from typing import Callable
 
 import jax
 import jax.numpy as jnp

@@ -12,7 +12,6 @@ sequence-parallel via :mod:`keystone_tpu.ops.attention` on a mesh.
 
 from __future__ import annotations
 
-import dataclasses
 import math
 
 import jax
