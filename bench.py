@@ -23,11 +23,66 @@ scalar index + host transfer.
 
 from __future__ import annotations
 
+import datetime
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+# Persisted record of the most recent SUCCESSFUL on-chip bench run. The
+# axon tunnel to the accelerator drops for hours at a time; when the
+# driver-run bench lands in such an outage the fallback line embeds this
+# record (clearly labeled ``last_good_tpu``) so the driver artifact
+# always carries the best driver-verifiable chip number (VERDICT r2 #1).
+TPU_CACHE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_TPU_LAST.json"
+)
+
+
+def _git_sha() -> str:
+    import subprocess
+
+    try:
+        return (
+            subprocess.run(
+                ["git", "rev-parse", "HEAD"],
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                capture_output=True,
+                text=True,
+                timeout=10,
+            ).stdout.strip()
+            or "unknown"
+        )
+    except Exception:  # noqa: BLE001
+        return "unknown"
+
+
+def save_tpu_record(result: dict) -> None:
+    """Persist a successful on-chip result (atomic rename so a crash
+    mid-write cannot corrupt the last good record)."""
+    import jax
+
+    record = {
+        "result": result,
+        "device_kind": jax.devices()[0].device_kind,
+        "num_devices": len(jax.devices()),
+        "timestamp": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+        "git_sha": _git_sha(),
+    }
+    tmp = TPU_CACHE_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(record, f, indent=1)
+    os.replace(tmp, TPU_CACHE_PATH)
+
+
+def load_tpu_record() -> dict | None:
+    try:
+        with open(TPU_CACHE_PATH) as f:
+            return json.load(f)
+    except Exception:  # noqa: BLE001
+        return None
 
 N_TRAIN = 60_000
 IMAGE_SIZE = 784
@@ -280,8 +335,6 @@ def _device_peak() -> float | None:
 
 
 def main() -> None:
-    import os
-
     global N_TRAIN, CIFAR_N
 
     # a cpu-pinned environment (e.g. the mid-run-failure rerun child)
@@ -365,7 +418,10 @@ def main() -> None:
         "(reference publishes no numbers; see BASELINE.md)",
     }
     if peak is not None and not fallback:
-        result["mfu_vs_bf16_peak"] = round(
+        # "est": featurize FLOPs are an analytic estimate (cosine gemm
+        # term only) — measured time, modeled FLOPs (ADVICE r2 #4). The
+        # solver-phase MFU is fully measured-FLOPs and kept separately.
+        result["mfu_est_vs_bf16_peak"] = round(
             max(
                 mnist["e2e_tflops_per_s"], cifar["conv_tflops_per_s"]
             )
@@ -373,6 +429,20 @@ def main() -> None:
             / peak,
             4,
         )
+        result["mfu_solver_vs_bf16_peak"] = round(
+            mnist["solver_tflops_per_s"] * 1e12 / peak, 4
+        )
+    if fallback:
+        cached = load_tpu_record()
+        if cached is not None:
+            result["last_good_tpu"] = cached
+    else:
+        try:
+            save_tpu_record(result)
+        except Exception as e:  # noqa: BLE001 — a cache-write failure
+            # (read-only checkout, full disk) must not discard the
+            # completed run: the driver line still prints
+            print(f"# bench cache write failed: {e!r}", file=sys.stderr)
     print(json.dumps(result))
 
 

@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Launch a pipeline on every worker of a Cloud TPU pod slice — the TPU
+# successor of the reference's cluster launcher (bin/pipelines-ec2.sh:
+# provision + submit to a Spark cluster). On TPU there is nothing to
+# provision per-job: every VM worker of the slice runs the SAME program,
+# jax.distributed.initialize() (the no-arg form, invoked by the
+# --multihost launcher flag) discovers coordinator/process-id from the
+# TPU metadata, and collectives ride ICI/DCN.
+#
+# Usage:
+#   bin/launch-tpu-pod.sh <tpu-name> <zone> <pipeline> [pipeline-args...]
+# e.g.
+#   bin/launch-tpu-pod.sh my-v5e-64 us-west4-a mnist-random-fft --synthetic 60000
+#
+# Environment:
+#   KEYSTONE_REMOTE_DIR   checkout path on the workers (default: ~/keystone_tpu)
+#   GCLOUD                gcloud binary (default: gcloud)
+#
+# The repo must already be present on the workers (e.g. synced via
+#   gcloud compute tpus tpu-vm scp --recurse . "$TPU":"$KEYSTONE_REMOTE_DIR" \
+#       --worker=all --zone="$ZONE"
+# ); this script only fans the run out, mirroring how pipelines-ec2.sh
+# assumed an AMI with the assembly jar staged.
+set -euo pipefail
+
+if [[ $# -lt 3 ]]; then
+  sed -n '2,16p' "${BASH_SOURCE[0]}"
+  exit 1
+fi
+
+TPU="$1"; ZONE="$2"; shift 2
+REMOTE_DIR="${KEYSTONE_REMOTE_DIR:-\$HOME/keystone_tpu}"
+GCLOUD="${GCLOUD:-gcloud}"
+
+# one SPMD program per worker; --multihost makes the launcher call
+# jax.distributed.initialize() before the pipeline builds its mesh
+"$GCLOUD" compute tpus tpu-vm ssh "$TPU" \
+  --zone="$ZONE" \
+  --worker=all \
+  --command="cd $REMOTE_DIR && PYTHONPATH=$REMOTE_DIR python -m keystone_tpu --multihost $*"

@@ -22,13 +22,57 @@ the same code path works single-chip and multi-host.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import json
 import pathlib
 
 import jax
+import numpy as np
 
 from keystone_tpu.core.logging import get_logger
 
 logger = get_logger(__name__)
+
+
+def _fit_meta(est, data, labels, n_valid) -> dict:
+    """Identity payload for a fit: estimator hyperparams (num_iter
+    excluded — resuming with a longer/shorter schedule is the point of
+    chunking, and the over-trained guard covers it), data/label leaf
+    shapes, a small content fingerprint, and n_valid. Stored as a JSON
+    sidecar so a rerun against the wrong directory fails loudly instead
+    of silently mixing two fits."""
+
+    def _leaf_info(tree) -> dict:
+        leaves = jax.tree_util.tree_leaves(tree)
+        shapes = [list(map(int, getattr(x, "shape", ()))) for x in leaves]
+        if leaves:
+            head = np.asarray(leaves[0].ravel()[:64])
+            digest = hashlib.sha256(
+                np.ascontiguousarray(head).tobytes()
+            ).hexdigest()[:16]
+        else:
+            digest = ""
+        return {"shapes": shapes, "sample_sha": digest}
+
+    params = {
+        f.name: getattr(est, f.name)
+        for f in dataclasses.fields(est)
+        if f.name != "num_iter"
+    }
+    # round-trip through json (default=str for arrays/enums) so the
+    # saved and freshly-computed dicts compare equal
+    return json.loads(
+        json.dumps(
+            {
+                "estimator": type(est).__name__,
+                "params": params,
+                "data": _leaf_info(data),
+                "labels": _leaf_info(labels),
+                "n_valid": n_valid,
+            },
+            default=str,
+        )
+    )
 
 
 def _manager(checkpoint_dir: str):
@@ -94,11 +138,44 @@ def resumable_fit(
     if every < 1:
         raise ValueError(f"every={every}: must be >= 1")
     total = est.num_iter
+    meta = _fit_meta(est, data, labels, n_valid)
+    meta_path = pathlib.Path(checkpoint_dir).absolute() / "fit_meta.json"
     mgr = _manager(checkpoint_dir)
+    try:
+        return _resumable_fit_inner(
+            est, data, labels, mgr, meta, meta_path, total, every, n_valid,
+            checkpoint_dir,
+        )
+    finally:
+        # per-call managers leak orbax background threads if not closed
+        # (a sweep calling checkpointed_fit repeatedly would accumulate)
+        mgr.close()
+
+
+def _resumable_fit_inner(
+    est, data, labels, mgr, meta, meta_path, total, every, n_valid,
+    checkpoint_dir,
+):
+    import orbax.checkpoint as ocp
+
     model = None
     done = 0
     latest = mgr.latest_step()
     if latest is not None:
+        if meta_path.exists():
+            saved = json.loads(meta_path.read_text())
+            if saved != meta:
+                diff = [
+                    k for k in set(saved) | set(meta)
+                    if saved.get(k) != meta.get(k)
+                ]
+                raise ValueError(
+                    f"{checkpoint_dir} holds checkpoints from a different "
+                    f"fit (mismatched: {sorted(diff)}) — resuming would "
+                    "mix two fits; point at a fresh directory.\n"
+                    f"  saved:   { {k: saved.get(k) for k in diff} }\n"
+                    f"  current: { {k: meta.get(k) for k in diff} }"
+                )
         if int(latest) > total:
             raise ValueError(
                 f"{checkpoint_dir} holds a {latest}-pass checkpoint but "
@@ -141,6 +218,16 @@ def resumable_fit(
                 done,
                 total,
             )
+    if latest is None or not meta_path.exists():
+        # overwrite unconditionally when no checkpoint exists yet: a
+        # crashed first-chunk run may have left a stale meta that would
+        # otherwise poison every later resume in this directory. Atomic
+        # tmp+replace (a crash mid-write must not corrupt the sidecar),
+        # written by process 0 only on multi-host filesystems.
+        if jax.process_index() == 0:
+            tmp = meta_path.with_suffix(".json.tmp")
+            tmp.write_text(json.dumps(meta, indent=1))
+            tmp.replace(meta_path)
     while done < total:
         step = min(every, total - done)
         chunk_est = dataclasses.replace(est, num_iter=step)

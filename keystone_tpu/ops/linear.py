@@ -322,7 +322,12 @@ class BlockLeastSquaresEstimator(LabelEstimator):
 
 
     def fit_sweep(
-        self, data, labels, lams, n_valid: int | None = None
+        self,
+        data,
+        labels,
+        lams,
+        n_valid: int | None = None,
+        sweep_chunk: int | None = None,
     ) -> list[BlockLinearMapper]:
         """Fit one model per ridge λ in ``lams`` at marginal cost.
 
@@ -334,22 +339,52 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         per-λ solves/residuals are batched (vmapped) over the sweep —
         an L-point sweep costs far less than L fits. Returns models in
         ``lams`` order.
+
+        Memory: the sweep residual is (L, N, C) — L multiplies residual
+        HBM, so at TIMIT scale (N~2M, C=147) even a 5-point sweep adds
+        ~6GB/chip. ``sweep_chunk`` bounds this by running the sweep a few
+        λs at a time (Grams are recomputed per chunk — the N·d² cost is
+        re-paid once per chunk, still far cheaper than L separate fits).
+        Default ``None`` auto-sizes chunks to keep the residual under
+        ~2GiB/process.
         """
         blocks = _split_blocks(data, self.block_size)
         lams_arr = jnp.asarray(lams, jnp.float32)
+        n_lam = int(lams_arr.shape[0])
+        if sweep_chunk is None:
+            per_lam = (
+                blocks[0].shape[0]
+                * labels.shape[-1]
+                * blocks[0].dtype.itemsize
+            )
+            sweep_chunk = max(1, min(n_lam, (2 << 30) // max(per_lam, 1)))
+        # _bcd_fit_sweep is jitted: an uneven tail chunk (2,2,1) would
+        # recompile the whole sweep program for the odd shape. Pad the
+        # λ array to a chunk multiple (repeating the last λ — the extra
+        # solves are marginal next to the shared Grams) so every chunk
+        # compiles once; the padded models are dropped at the end.
+        sweep_chunk = min(sweep_chunk, n_lam)
+        n_pad = -(-n_lam // sweep_chunk) * sweep_chunk
+        lams_pad = jnp.concatenate(
+            [lams_arr, jnp.broadcast_to(lams_arr[-1:], (n_pad - n_lam,))]
+        )
+        models: list[BlockLinearMapper] = []
         with _matmul_precision(self.precision):
-            xs_l, means, intercept = _bcd_fit_sweep(
-                tuple(blocks), labels, n_valid, lams_arr, self.num_iter
-            )
-        return [
-            BlockLinearMapper(
-                xs=tuple(xb[i] for xb in xs_l),
-                b=intercept,
-                means=means,
-                block_size=self.block_size,
-            )
-            for i in range(lams_arr.shape[0])
-        ]
+            for s in range(0, n_pad, sweep_chunk):
+                chunk = lams_pad[s : s + sweep_chunk]
+                xs_l, means, intercept = _bcd_fit_sweep(
+                    tuple(blocks), labels, n_valid, chunk, self.num_iter
+                )
+                models.extend(
+                    BlockLinearMapper(
+                        xs=tuple(xb[i] for xb in xs_l),
+                        b=intercept,
+                        means=means,
+                        block_size=self.block_size,
+                    )
+                    for i in range(chunk.shape[0])
+                )
+        return models[:n_lam]
 
 
 def _block_stats(blocks: tuple, labels, n_valid):

@@ -239,8 +239,13 @@ def _weighted_bcd_fit(
     for a in blocks:
         a_m = a * mask
         pop_mean = jnp.sum(a_m, axis=0) / n
-        gram = a_m.T @ a_m  # sharded contraction → psum
-        pop_cov = gram / n - jnp.outer(pop_mean, pop_mean)
+        # covariance from CENTERED rows, not gram/n − μμᵀ: the
+        # subtraction form loses |μ|²/|cov| digits to cancellation in
+        # f32 (fatal when features have large means or rows are
+        # near-duplicates — the noise lands on λ's scale and destabilizes
+        # the BCD fixed point at small λ)
+        a_cm = (a - pop_mean) * mask
+        pop_cov = (a_cm.T @ a_cm) / n  # sharded contraction → psum
         class_mean = class_sum(a_m) / n_c_safe[:, None]  # (C, d)
         joint_mean = w * class_mean + (1 - w) * pop_mean  # (C, d)
         pop_means.append(pop_mean)
@@ -287,22 +292,28 @@ def _weighted_bcd_fit(
     # Cholesky costs C·d³/3 and TPU factorizations run at a fixed
     # ~15-30 ms per 147-matrix batch on v5e REGARDLESS of size
     # (sequential panels), so when the grid layout is active and the
-    # correction rank L+2 ≤ d/2 the solves go through Woodbury instead.
-    # The correction splits as V Vᵀ − q qᵀ with
-    #   V = [√(w/n_c)·A_cᵀ, √(w(1−w))·md]   (L+1 POSITIVE columns)
-    #   q = √w·mu ,
-    # so M = B + VVᵀ − qqᵀ with shared SPD base B = (1−w)·pop_cov + λI.
-    # M1 = B + VVᵀ inverts by Woodbury with SPD inner G = I + VᵀB⁻¹V;
-    # the −qqᵀ downdate folds in by Sherman–Morrison (scalars only).
-    # G⁻¹ comes from a fixed-depth Newton–Schulz iteration (two (L+1)²
-    # gemms per step; G's eigenvalues are ≥ 1 so the scaled-identity
-    # init converges quadratically) — the whole per-class pipeline is
-    # factorization-free gemms on the MXU, 5-40x faster than batched
-    # dense Cholesky at TIMIT/ImageNet class counts. (The reference
-    # solves each class densely on its own executor,
+    # correction is low-rank (gated at L+2 ≤ d/2; the centered form's
+    # actual rank is L+1, so the historical L+2 gate is one column
+    # conservative) the solves go through Woodbury instead.
+    # The correction is written as a SUM of positive rank-1 terms only:
+    #   w·class_cov_c = (w/n_c)·Σᵢ (aᵢ−μ_c)(aᵢ−μ_c)ᵀ   (CENTERED rows)
+    #   V = [√(w/n_c)·(A_c−μ_c)ᵀ, √(w(1−w))·md_c]   (L+1 columns)
+    # so M = B + VVᵀ with shared SPD base B = (1−w)·pop_cov + λI and
+    # Woodbury's SPD inner matrix G = I + VᵀB⁻¹V (eigs ≥ 1), inverted
+    # exactly by a tiny equilibrated batched Cholesky once per fit.
+    # (An earlier formulation used UNcentered rows plus a −qqᵀ
+    # Sherman–Morrison downdate, q = √w·μ_c. That subtraction is
+    # numerically fatal for degenerate classes: near-duplicate rows make
+    # class_cov ≈ 0, the downdate nearly cancels a VVᵀ direction, and
+    # the f32 denominator 1−qᵀM₁⁻¹q crosses zero — coefficients blew up
+    # ~1e6× on the adversarial tests. Centering eliminates the
+    # subtraction, so M's low-rank part is monotone in every direction.)
+    # Per-pass solves are then pure gemms on the MXU — 5-40x faster than
+    # batched dense Cholesky at TIMIT/ImageNet class counts. (The
+    # reference solves each class densely on its own executor,
     # BlockWeightedLeastSquares.scala:228-263 — right on CPUs, wrong on
     # a systolic array.) Everything except the right-hand side is
-    # pass-invariant, so v/y/ginv/q/p/denom are built ONCE per fit here
+    # pass-invariant, so v/y/ginv are built ONCE per fit here
     # (costs ~2·C·d·(L+1) floats of HBM — the same order as the grid
     # copy itself) and the per-pass work is rhs assembly + solves.
     use_woodbury = [
@@ -331,34 +342,49 @@ def _weighted_bcd_fit(
             mu = s["class_mean"]  # (S, d)
             md = mu - pop_mean
             scale = jnp.sqrt(w / jnp.maximum(s["n_c"], 1.0))
+            # center the class rows about μ_c; sentinel (padding) slots
+            # hold zero rows, which centering would turn into −μ_c and
+            # corrupt class_cov — mask them back to zero
+            valid = (
+                jnp.arange(s["a_rows"].shape[1])[None, :]
+                < s["n_c"][:, None]
+            ).astype(dtype)  # (S, L)
+            centered_rows = (s["a_rows"] - mu[:, None, :]) * valid[
+                :, :, None
+            ]  # (S, L, d)
             v = jnp.concatenate(
                 [
-                    s["a_rows"].transpose(0, 2, 1) * scale[:, None, None],
+                    centered_rows.transpose(0, 2, 1)
+                    * scale[:, None, None],
                     (np.sqrt(w * (1 - w)) * md)[:, :, None],
                 ],
                 axis=2,
             )  # (S, d, L+1)
-            q = np.sqrt(w) * mu  # (S, d)
             y = jnp.einsum("de,sek->sdk", b_inv, v)  # B⁻¹V
             g = jnp.einsum("sdi,sdj->sij", v, y) + jnp.eye(lp1, dtype=dtype)
-            # Newton–Schulz: X ← X(2I − GX), X₀ = I/‖G‖₁;
-            # eigs(GX₀) ∈ (0, 1], error contracts as (1−λ/‖G‖₁)^(2^k)
-            norm1 = jnp.max(jnp.sum(jnp.abs(g), axis=-1), axis=-1)
-            x_ns = jnp.eye(lp1, dtype=dtype)[None] / norm1[:, None, None]
-            eye2 = 2.0 * jnp.eye(lp1, dtype=dtype)
-            ginv = jax.lax.fori_loop(
-                0, 16, lambda _, xk: xk @ (eye2 - g @ xk), x_ns
-            )
-            z = jnp.einsum("de,se->sd", b_inv, q)
-            t = jnp.einsum(
-                "sij,sj->si", ginv, jnp.einsum("sdi,sd->si", v, z)
-            )
-            p = z - jnp.einsum("sdi,si->sd", y, t)  # M1⁻¹q
-            denom = 1.0 - jnp.einsum("sd,sd->s", q, p)  # > 0: M is PD
-            return {
-                "v": v, "y": y, "ginv": ginv, "q": q, "p": p,
-                "denom": denom,
-            }
+            # exact equilibrated-Cholesky inverse of the (L+1)² inner
+            # matrix. G is SPD with eigs ≥ 1, but its spread tracks
+            # ‖B⁻¹‖ — near-duplicate rows with tiny λ push it past 1e6,
+            # where a fixed-depth Newton–Schulz iteration (the original
+            # design) stalls on the unit eigenvalues and poisons every
+            # downstream solve. The factorization is (L+1)³ per class
+            # ONCE per fit — noise next to the N·d² Grams — so exactness
+            # costs nothing that matters.
+            def _inv_spd(gm):
+                s = jax.lax.rsqrt(
+                    jnp.clip(jnp.diagonal(gm), 1e-30, None)
+                )
+                me = gm * (s[:, None] * s[None, :]) + 1e-6 * jnp.eye(
+                    lp1, dtype=dtype
+                )
+                cf = jax.scipy.linalg.cho_factor(me)
+                inv = jax.scipy.linalg.cho_solve(
+                    cf, jnp.eye(lp1, dtype=dtype)
+                )
+                return inv * (s[:, None] * s[None, :])
+
+            ginv = jax.vmap(_inv_spd)(g)
+            return {"v": v, "y": y, "ginv": ginv}
 
         wood_pre.append(jax.lax.map(prep_chunk, static))
 
@@ -406,9 +432,8 @@ def _weighted_bcd_fit(
                 def solve_chunk(args, b_inv=b_invs[i], pop_cov=pop_cov):
                     pre, s = args
                     v, y, ginv = pre["v"], pre["y"], pre["ginv"]
-                    q, p, denom = pre["q"], pre["p"], pre["denom"]
 
-                    def m1solve(r):  # (B + VVᵀ)⁻¹ r, all gemms
+                    def wsolve(r):  # M⁻¹r = (B + VVᵀ)⁻¹r, all gemms
                         z = jnp.einsum("de,se->sd", b_inv, r)
                         t = jnp.einsum(
                             "sij,sj->si",
@@ -417,27 +442,23 @@ def _weighted_bcd_fit(
                         )
                         return z - jnp.einsum("sdi,si->sd", y, t)
 
-                    def wsolve(r):  # M⁻¹r via Sherman–Morrison downdate
-                        u1 = m1solve(r)
-                        coef = jnp.einsum("sd,sd->s", q, u1) / denom
-                        return u1 + p * coef[:, None]
-
                     def matvec(x):  # (joint_xtx + λI) x, never formed
                         bx = (1 - w) * jnp.einsum(
                             "de,se->sd", pop_cov, x
                         ) + lam * x
                         vx = jnp.einsum("sdi,sd->si", v, x)
-                        qx = jnp.einsum("sd,sd->s", q, x)
-                        return (
-                            bx
-                            + jnp.einsum("sdi,si->sd", v, vx)
-                            - q * qx[:, None]
-                        )
+                        return bx + jnp.einsum("sdi,si->sd", v, vx)
 
                     rhs = chunk_rhs(s)
                     x = wsolve(rhs)
-                    for _ in range(3):  # NS inverse is approximate: one
-                        # extra refine step vs ridge_solve's two
+                    # the Woodbury apply is algebraically exact but
+                    # subtracts two large terms when B is
+                    # ill-conditioned (z and the V-correction both scale
+                    # with ‖B⁻¹‖): iterative refinement against the
+                    # never-formed true operator recovers the cancelled
+                    # f32 digits — one step more than ridge_solve's two,
+                    # sized by the adversarial-conditioning tests
+                    for _ in range(3):
                         x = x + wsolve(rhs - matvec(x))
                     return x  # (S, d)
 
@@ -467,20 +488,34 @@ def _weighted_bcd_fit(
                 def solve_chunk(
                     s, a_m=a_m, pop_cov=pop_cov, pop_mean=pop_mean
                 ):
+                    mu = s["class_mean"]  # (S, d)
                     if class_l is not None:
-                        # (S, L, d) → (S, d, d): N·d² total across chunks
-                        g = jnp.einsum(
-                            "sld,sle->sde", s["a_rows"], s["a_rows"]
+                        # (S, L, d) → (S, d, d): N·d² total across
+                        # chunks, from CENTERED rows — no g/n_c − μμᵀ
+                        # cancellation (see pop_cov comment above);
+                        # sentinel slots are zero rows that centering
+                        # would turn into −μ, so mask them out
+                        valid = (
+                            jnp.arange(class_l)[None, :]
+                            < s["n_c"][:, None]
+                        ).astype(dtype)  # (S, L)
+                        rows_c = (
+                            s["a_rows"] - mu[:, None, :]
+                        ) * valid[:, :, None]
+                        class_cov = (
+                            jnp.einsum("sld,sle->sde", rows_c, rows_c)
+                            / s["n_c"][:, None, None]
                         )
                     else:
-                        # masked full-batch reduction: C·N·d²
+                        # masked full-batch reduction: C·N·d²; no row
+                        # gather available, so this keeps the
+                        # subtraction form
                         g = jnp.einsum(
                             "nd,ns,ne->sde", a_m, s["onehot"], a_m
                         )
-                    mu = s["class_mean"]  # (S, d)
-                    class_cov = g / s["n_c"][:, None, None] - jnp.einsum(
-                        "sd,se->sde", mu, mu
-                    )
+                        class_cov = g / s["n_c"][
+                            :, None, None
+                        ] - jnp.einsum("sd,se->sde", mu, mu)
                     md = mu - pop_mean  # (S, d)
                     joint_xtx = (
                         (1 - w) * pop_cov[None]

@@ -1,0 +1,79 @@
+"""Worker program for the 2-process multi-host parity test.
+
+NOT a test module (no ``test_`` prefix): ``test_multihost.py`` launches two
+copies of this script — the same SPMD program on every process, exactly how
+a TPU pod runs it (``bin/launch-tpu-pod.sh``). Each process contributes its
+local half of the rows, ``global_batch_from_local`` assembles the global
+data-sharded array, and the fit's Gram contractions psum across processes
+over the gloo CPU collectives (ICI's stand-in on the test rig).
+
+Usage: python multihost_worker.py <process_id> <num_processes> <port> <out>
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> None:
+    pid, nprocs, port, out_path = (
+        int(sys.argv[1]),
+        int(sys.argv[2]),
+        sys.argv[3],
+        sys.argv[4],
+    )
+    import numpy as np
+
+    from keystone_tpu.ops.linear import BlockLeastSquaresEstimator
+    from keystone_tpu.parallel import multihost
+    from keystone_tpu.parallel.mesh import create_mesh
+
+    multihost.initialize(
+        coordinator_address=f"localhost:{port}",
+        num_processes=nprocs,
+        process_id=pid,
+    )
+    assert jax.process_count() == nprocs, jax.process_count()
+    n_global_dev = jax.device_count()
+
+    # deterministic dataset, identical on every process; each process
+    # feeds only ITS rows into the global array (row-block layout: rows
+    # land on devices in process order, matching a contiguous split)
+    rng = np.random.default_rng(0)
+    n, d, c = 256, 24, 4
+    cls = rng.integers(0, c, size=n)
+    centers = rng.normal(size=(c, d)).astype(np.float32) * 2
+    data = (centers[cls] + rng.normal(size=(n, d))).astype(np.float32)
+    labels = -np.ones((n, c), np.float32)
+    labels[np.arange(n), cls] = 1.0
+
+    mesh = create_mesh(data=n_global_dev)
+    lo, hi = pid * n // nprocs, (pid + 1) * n // nprocs
+    g_data = multihost.global_batch_from_local(data[lo:hi], mesh)
+    g_labels = multihost.global_batch_from_local(labels[lo:hi], mesh)
+    assert g_data.shape == (n, d), g_data.shape
+
+    est = BlockLeastSquaresEstimator(block_size=7, num_iter=3, lam=0.1)
+    model = est.fit(g_data, g_labels, n_valid=n)
+
+    # model leaves are replicated solver outputs: every process holds the
+    # full values; process 0 writes them for the parity check
+    if pid == 0:
+        xs = [np.asarray(x) for x in model.xs]
+        np.savez(
+            out_path,
+            b=np.asarray(model.b),
+            n_xs=len(xs),
+            **{f"x{i}": x for i, x in enumerate(xs)},
+        )
+    print(f"worker {pid}: ok", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -69,3 +69,105 @@ def test_probe_failure_falls_back_inline(monkeypatch, capsys):
     rec = json.loads(out)
     assert "CPU FALLBACK" in rec["metric"]
     assert called["n"] == 12_000  # fallback shrinks the workload
+
+
+def test_fallback_embeds_last_good_tpu(monkeypatch, capsys, tmp_path):
+    bench = _load_bench()
+    cache = tmp_path / "BENCH_TPU_LAST.json"
+    cache.write_text(
+        json.dumps(
+            {
+                "result": {"metric": "m", "value": 123.0},
+                "device_kind": "TPU v5 lite",
+                "timestamp": "2026-07-30T00:00:00+00:00",
+                "git_sha": "abc123",
+            }
+        )
+    )
+    monkeypatch.setattr(bench, "TPU_CACHE_PATH", str(cache))
+    monkeypatch.setattr(bench, "_accelerator_alive", lambda: False)
+    monkeypatch.setattr(
+        bench,
+        "bench_mnist",
+        lambda *a: {
+            "samples_per_s": 10.0,
+            "step_ms": 1.0,
+            "solver_gflops": 1.0,
+            "solver_tflops_per_s": 0.001,
+            "e2e_tflops_per_s": 0.002,
+        },
+    )
+    monkeypatch.setattr(
+        bench,
+        "bench_cifar_conv",
+        lambda: {"samples_per_s": 5.0, "conv_tflops_per_s": 0.001},
+    )
+    monkeypatch.setattr(bench, "bench_cpu_numpy", lambda *a: 10.0)
+    monkeypatch.setattr(bench, "bench_cpu_cifar_conv", lambda: 5.0)
+    bench.main()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["last_good_tpu"]["result"]["value"] == 123.0
+    assert rec["last_good_tpu"]["device_kind"] == "TPU v5 lite"
+    assert rec["last_good_tpu"]["git_sha"] == "abc123"
+
+
+def test_fallback_without_cache_omits_key(monkeypatch, capsys, tmp_path):
+    bench = _load_bench()
+    monkeypatch.setattr(
+        bench, "TPU_CACHE_PATH", str(tmp_path / "missing.json")
+    )
+    monkeypatch.setattr(bench, "_accelerator_alive", lambda: False)
+    monkeypatch.setattr(
+        bench,
+        "bench_mnist",
+        lambda *a: {
+            "samples_per_s": 10.0,
+            "step_ms": 1.0,
+            "solver_gflops": 1.0,
+            "solver_tflops_per_s": 0.001,
+            "e2e_tflops_per_s": 0.002,
+        },
+    )
+    monkeypatch.setattr(
+        bench,
+        "bench_cifar_conv",
+        lambda: {"samples_per_s": 5.0, "conv_tflops_per_s": 0.001},
+    )
+    monkeypatch.setattr(bench, "bench_cpu_numpy", lambda *a: 10.0)
+    monkeypatch.setattr(bench, "bench_cpu_cifar_conv", lambda: 5.0)
+    bench.main()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "last_good_tpu" not in rec
+
+
+def test_success_persists_tpu_record(monkeypatch, tmp_path, capsys):
+    bench = _load_bench()
+    cache = tmp_path / "BENCH_TPU_LAST.json"
+    monkeypatch.setattr(bench, "TPU_CACHE_PATH", str(cache))
+    monkeypatch.setenv("JAX_PLATFORMS", "")
+    monkeypatch.setattr(bench, "_accelerator_alive", lambda: True)
+    monkeypatch.setattr(
+        bench,
+        "bench_mnist",
+        lambda *a: {
+            "samples_per_s": 10.0,
+            "step_ms": 1.0,
+            "solver_gflops": 1.0,
+            "solver_tflops_per_s": 0.001,
+            "e2e_tflops_per_s": 0.002,
+        },
+    )
+    monkeypatch.setattr(
+        bench,
+        "bench_cifar_conv",
+        lambda: {"samples_per_s": 5.0, "conv_tflops_per_s": 0.001},
+    )
+    monkeypatch.setattr(bench, "bench_cpu_numpy", lambda *a: 10.0)
+    monkeypatch.setattr(bench, "bench_cpu_cifar_conv", lambda: 5.0)
+    bench.main()
+    saved = json.loads(cache.read_text())
+    assert saved["result"]["value"] == 10.0
+    assert saved["git_sha"]
+    assert saved["timestamp"]
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "CPU FALLBACK" not in line["metric"]

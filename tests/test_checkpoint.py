@@ -91,3 +91,42 @@ def test_resumable_fit_resumes_after_interrupt(rng, tmp_path):
     )
     model = resumable_fit(est, a, y, checkpoint_dir=ckdir, every=2)
     _assert_models_close(model, est.fit(a, y))
+
+
+def test_resume_rejects_changed_hyperparams(rng, tmp_path):
+    import pytest
+
+    a, y = _data(rng)
+    est = BlockLeastSquaresEstimator(block_size=5, num_iter=4, lam=0.1)
+    ck = str(tmp_path / "ck")
+    resumable_fit(est, a, y, checkpoint_dir=ck, every=2)
+    # changed lam: resuming would silently mix two different fits
+    with pytest.raises(ValueError, match="different fit"):
+        resumable_fit(
+            dataclasses.replace(est, lam=0.5), a, y,
+            checkpoint_dir=ck, every=2,
+        )
+
+
+def test_resume_rejects_different_data(rng, tmp_path):
+    import pytest
+
+    a, y = _data(rng)
+    est = BlockLeastSquaresEstimator(block_size=5, num_iter=4, lam=0.1)
+    ck = str(tmp_path / "ck")
+    resumable_fit(est, a, y, checkpoint_dir=ck, every=2)
+    a2 = a.at[0, 0].add(1.0)  # same shape, different content
+    with pytest.raises(ValueError, match="different fit"):
+        resumable_fit(est, a2, y, checkpoint_dir=ck, every=2)
+
+
+def test_resume_accepts_longer_schedule(rng, tmp_path):
+    # num_iter is deliberately NOT part of fit identity: extending a
+    # 2-pass checkpoint to 4 passes is exact warm-start continuation
+    a, y = _data(rng)
+    ck = str(tmp_path / "ck")
+    est2 = BlockLeastSquaresEstimator(block_size=5, num_iter=2, lam=0.1)
+    resumable_fit(est2, a, y, checkpoint_dir=ck, every=2)
+    est4 = dataclasses.replace(est2, num_iter=4)
+    resumed = resumable_fit(est4, a, y, checkpoint_dir=ck, every=2)
+    _assert_models_close(resumed, est4.fit(a, y))

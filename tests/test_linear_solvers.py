@@ -272,3 +272,18 @@ def test_linear_map_fit_sweep_matches_individual(rng):
         np.testing.assert_allclose(
             np.asarray(m(a)), np.asarray(single(a)), atol=1e-4
         )
+
+
+def test_fit_sweep_chunked_matches_unchunked(rng):
+    a = jnp.asarray(rng.normal(size=(64, 20)).astype(np.float32))
+    y = jnp.asarray(rng.normal(size=(64, 3)).astype(np.float32))
+    est = BlockLeastSquaresEstimator(block_size=7, num_iter=2, lam=0.1)
+    lams = [0.01, 0.1, 1.0, 10.0, 100.0]
+    full = est.fit_sweep(a, y, lams)
+    chunked = est.fit_sweep(a, y, lams, sweep_chunk=2)
+    assert len(full) == len(chunked) == len(lams)
+    for m1, m2 in zip(full, chunked):
+        for x1, x2 in zip(m1.xs, m2.xs):
+            np.testing.assert_allclose(
+                np.asarray(x1), np.asarray(x2), atol=1e-5
+            )
