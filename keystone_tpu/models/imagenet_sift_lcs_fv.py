@@ -137,15 +137,31 @@ def _tar_source(conf: ImageNetConfig, which: str):
 
 
 def _synthetic_source(conf: ImageNetConfig, which: str):
-    """Serve the synthetic corpus through the streaming iterator contract."""
-    data, _ = _load(conf, which)
+    """Serve the synthetic corpus through the streaming iterator contract.
+
+    Batches are generated LAZILY and deterministically (per-batch rngs):
+    at ImageNet scale the eager `_load` corpus would be ~80GB of host RAM
+    for 100k 256² images — materializing it would defeat the bounded-
+    memory property the streaming path exists to provide. Same
+    distribution as `_load` (shared class centers, per-batch noise), so
+    small-scale tests that compare against the eager path stay valid.
+    """
+    k = conf.synthetic_classes
+    n = conf.synthetic if which == "train" else max(conf.synthetic // 4, 1)
+    seed = 0 if which == "train" else 1
+    centers = np.random.default_rng(42).normal(
+        loc=128, scale=30, size=(k, 8, 8, 3)
+    )
+    up = conf.image_size // 8
 
     def source():
-        for s in range(0, len(data.labels), conf.stream_batch):
-            yield (
-                data.images[s : s + conf.stream_batch],
-                data.labels[s : s + conf.stream_batch],
-            )
+        for s in range(0, n, conf.stream_batch):
+            b = min(conf.stream_batch, n - s)
+            rng = np.random.default_rng((seed, s))
+            labels = rng.integers(0, k, size=b).astype(np.int32)
+            imgs = np.kron(centers[labels], np.ones((1, up, up, 1)))
+            imgs += rng.normal(scale=20, size=imgs.shape)
+            yield np.clip(imgs, 0, 255).astype(np.float32), labels
 
     return source
 

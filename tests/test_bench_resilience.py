@@ -64,6 +64,13 @@ def test_probe_failure_falls_back_inline(monkeypatch, capsys):
     )
     monkeypatch.setattr(bench, "bench_cpu_numpy", lambda *a: 10.0)
     monkeypatch.setattr(bench, "bench_cpu_cifar_conv", lambda: 5.0)
+    monkeypatch.setattr(
+        bench,
+        "bench_weighted",
+        lambda: {"samples_per_s": 7.0, "tflops_per_s": 0.003},
+    )
+    monkeypatch.setattr(bench, "bench_cpu_weighted", lambda: 7.0)
+    monkeypatch.setattr(bench, "bench_sift", lambda: {"images_per_s": 2.0})
     bench.main()
     out = capsys.readouterr().out.strip().splitlines()[-1]
     rec = json.loads(out)
@@ -104,6 +111,13 @@ def test_fallback_embeds_last_good_tpu(monkeypatch, capsys, tmp_path):
     )
     monkeypatch.setattr(bench, "bench_cpu_numpy", lambda *a: 10.0)
     monkeypatch.setattr(bench, "bench_cpu_cifar_conv", lambda: 5.0)
+    monkeypatch.setattr(
+        bench,
+        "bench_weighted",
+        lambda: {"samples_per_s": 7.0, "tflops_per_s": 0.003},
+    )
+    monkeypatch.setattr(bench, "bench_cpu_weighted", lambda: 7.0)
+    monkeypatch.setattr(bench, "bench_sift", lambda: {"images_per_s": 2.0})
     bench.main()
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["last_good_tpu"]["result"]["value"] == 123.0
@@ -135,6 +149,13 @@ def test_fallback_without_cache_omits_key(monkeypatch, capsys, tmp_path):
     )
     monkeypatch.setattr(bench, "bench_cpu_numpy", lambda *a: 10.0)
     monkeypatch.setattr(bench, "bench_cpu_cifar_conv", lambda: 5.0)
+    monkeypatch.setattr(
+        bench,
+        "bench_weighted",
+        lambda: {"samples_per_s": 7.0, "tflops_per_s": 0.003},
+    )
+    monkeypatch.setattr(bench, "bench_cpu_weighted", lambda: 7.0)
+    monkeypatch.setattr(bench, "bench_sift", lambda: {"images_per_s": 2.0})
     bench.main()
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert "last_good_tpu" not in rec
@@ -164,6 +185,13 @@ def test_success_persists_tpu_record(monkeypatch, tmp_path, capsys):
     )
     monkeypatch.setattr(bench, "bench_cpu_numpy", lambda *a: 10.0)
     monkeypatch.setattr(bench, "bench_cpu_cifar_conv", lambda: 5.0)
+    monkeypatch.setattr(
+        bench,
+        "bench_weighted",
+        lambda: {"samples_per_s": 7.0, "tflops_per_s": 0.003},
+    )
+    monkeypatch.setattr(bench, "bench_cpu_weighted", lambda: 7.0)
+    monkeypatch.setattr(bench, "bench_sift", lambda: {"images_per_s": 2.0})
     bench.main()
     saved = json.loads(cache.read_text())
     assert saved["result"]["value"] == 10.0
