@@ -14,9 +14,11 @@ mesh "data" axis. The whole pipeline is pure jnp — no native kernels.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import time
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from keystone_tpu.core.config import arg, parse_config
@@ -86,11 +88,69 @@ def _featurize_batch(chains: tuple, data):
     return ZipVectors()([chain(data) for chain in chains])
 
 
+def _sign_fft_relu_parts(chain):
+    """Match the ``RandomSignNode >> PaddedFFT >> LinearRectifier`` shape;
+    returns (signs, fft_impl, alpha, max_val) or None."""
+    nodes = getattr(chain, "nodes", ())
+    if len(nodes) != 3:
+        return None
+    s, f, r = nodes
+    if not (
+        isinstance(s, RandomSignNode)
+        and isinstance(f, PaddedFFT)
+        and isinstance(r, LinearRectifier)
+    ):
+        return None
+    return s.signs, f.impl, r.alpha, r.max_val
+
+
+@functools.partial(jax.jit, static_argnames=("n", "alpha", "max_val"))
+def _featurize_fused(signs_mat, data, n: int, alpha: float, max_val: float):
+    """All chains of one feature batch as ONE gemm: the sign flip is a
+    diagonal on the gemm's contraction side, so k chains fold into
+    ``relu(X @ [diag(s_1)C | … | diag(s_k)C])`` — one MXU pass over the
+    batch instead of k (reads X once; wider output tile)."""
+    from keystone_tpu.ops.stats import _cos_matrix
+
+    d = data.shape[-1]
+    cos = _cos_matrix(d, n, str(data.dtype))  # (d, n//2)
+    w = (signs_mat[:, :, None] * cos[None]).transpose(1, 0, 2)
+    w = w.reshape(d, -1)  # chain-major columns == ZipVectors order
+    return jnp.maximum(max_val, data @ w - alpha)
+
+
 def featurize(batch_featurizers: list[list[Pipeline]], data) -> list:
-    """Apply each batch of chains → list of (N, ≤block_size) feature blocks."""
-    return [
-        _featurize_batch(tuple(chains), data) for chains in batch_featurizers
-    ]
+    """Apply each batch of chains → list of (N, ≤block_size) feature blocks.
+
+    When a batch is all (sign → fft → relu) chains and the FFT resolves
+    to the matmul backend (TPU), the whole batch runs as one fused gemm;
+    identical values either way (the matmul backend IS the fft values).
+    """
+    from keystone_tpu.ops.flash_attention import on_tpu
+
+    out = []
+    for chains in batch_featurizers:
+        parts = [_sign_fft_relu_parts(c) for c in chains]
+        fusable = all(p is not None for p in parts) and len(parts) > 0
+        if fusable:
+            signs, impls, alphas, maxvals = zip(*parts)
+            fusable = (
+                len(set(alphas)) == 1
+                and len(set(maxvals)) == 1
+                and all(i in ("auto", "matmul") for i in impls)
+                and (on_tpu() or all(i == "matmul" for i in impls))
+            )
+        if fusable:
+            d = signs[0].shape[-1]
+            n = 2 * fft_features(d)
+            out.append(
+                _featurize_fused(
+                    jnp.stack(signs), data, n, alphas[0], maxvals[0]
+                )
+            )
+        else:
+            out.append(_featurize_batch(tuple(chains), data))
+    return out
 
 
 def _load(conf: MnistRandomFFTConfig, which: str) -> LabeledData:

@@ -127,15 +127,67 @@ happy serious ready available likely short single medical dark various
 entire close legal religious cold final main green nice huge popular
 traditional cultural wide deep fast red white black blue wrong strange
 safe rich fair weak direct open
+ride smile dance hope bake race trade vote shine slide glide hide wave
+save name tape note date rate hate gaze blame frame phrase praise raise
+curse nurse argue value issue pursue rescue tie lie dye move prove love
+solve serve curve carve merge urge charge change orange arrange manage
+damage image voyage store score snore ignore explore restore bounce
+pounce announce pronounce balance advance silence notice practice slice
+price surface promise house mouse excuse refuse confuse amuse accuse
+pause cause clause cease increase decrease release lease please tease
+breathe bathe clothe scrape escape shape smoke poke joke stroke strike
+like bike hike invite excite unite write quote vote devote promote
+complete compete delete create relate debate locate rotate operate
+separate update estimate generate iterate calculate populate simulate
+hero potato tomato echo veto torpedo zero bus gas plus virus focus bonus
+campus status circus genius radius chorus minus walrus octopus
+wish push crash flash brush crush finish publish polish punish vanish
+establish furnish banish cherish flourish nourish astonish diminish
+accomplish distinguish extinguish
+seed need feed speed breed greed deed weed bleed creed exceed proceed
+succeed agree free flee tree knee degree guarantee shoe toe hoe canoe
+cry dry fry spy marry bury copy empty apply reply supply imply comply
+multiply occupy vary envy pity deny defy rely satisfy qualify classify
+identify specify modify notify justify simplify clarify verify worry
+hurry bully rally tally delay enjoy employ destroy annoy obey pray stray
+jump swim grab hug ship shop chat clap jog nod pat rob rub skip slip snap
+tap trap trim wrap swap scan scrub drag beg bet dim fan grin hop jam
+knit map mop mug nap pad peg pin plug pop prop quit rip shrug sip skim
+slam slap slot span spot stem stir strap strip tan tip tug whip zip
 """.split())
 
-# (suffix, replacement) detachment rules, tried in order; the first rule
-# whose candidate survives orthographic repair + lexicon/shape checks wins
+# invariant forms that end in rule suffixes but must never be stemmed
+# ("news" → "new" was a real regression caught by the held-out word list)
+_INVARIANT = frozenset(
+    "news species series means physics mathematics economics politics "
+    "statistics athletics ethics headquarters measles diabetes "
+    "sheep deer fish swine aircraft indeed".split()
+)
+
+# (suffix, replacement, fallback_ok) detachment rules, tried in order;
+# the first rule whose candidate survives orthographic repair +
+# lexicon/shape checks wins. The paired strip/+e forms are morphy's
+# actual verb rule set — ("ed","e")/("ing","e") restore silent e without
+# the CVC guesswork an orthographic-only repair needs ("created" →
+# "creat"+CVC blocked, but rule "ed"→"e" proposes "create" directly).
+# ``fallback_ok`` marks rules whose stem is a sane default for
+# out-of-lexicon words: restoration rules for noun suffixes ("clues" →
+# "clue", "puppies" → "puppy") and BARE strips for -ed/-ing (an
+# unvalidated "+e" verb guess like "jumped" → "jumpe" is worse than the
+# strip "jump").
 _DETACH = (
-    ("sses", "ss"), ("ches", "ch"), ("shes", "sh"), ("xes", "x"),
-    ("zes", "z"), ("ies", "y"), ("ves", "f"),
-    ("ing", ""), ("edly", ""), ("ed", ""), ("est", ""), ("er", ""),
-    ("ly", ""), ("es", "e"), ("es", ""), ("s", ""),
+    ("sses", "ss", True), ("ches", "ch", True), ("shes", "sh", True),
+    ("xes", "x", True), ("zes", "z", True), ("ies", "y", True),
+    ("ied", "y", True), ("ves", "f", True), ("oes", "o", True),
+    # +e BEFORE bare strip: a CVC verb doubles its consonant before
+    # -ed/-ing ("hopped"), so an undoubled stem ("hoped" → "hop") means
+    # the lemma had a silent e — validation rejects "+e" when wrong
+    # ("visited" → "visite" fails, falls through to "visit")
+    ("ing", "e", False), ("ing", "", True), ("edly", "", True),
+    ("ed", "e", False), ("ed", "", True),
+    ("est", "", True), ("er", "", True),
+    ("ly", "", True), ("es", "e", True), ("es", "", True),
+    ("s", "", True),
 )
 
 _VOWELS = set("aeiou")
@@ -169,17 +221,17 @@ def default_lemmatize(token: str) -> str:
     t = token.lower()
     if t in _IRREGULAR:
         return _IRREGULAR[t]
-    if t in _LEXICON or len(t) < 4 or not t.isalpha():
+    if t in _INVARIANT or t in _LEXICON or len(t) < 4 or not t.isalpha():
         return t
     fallback = None
-    for suffix, repl in _DETACH:
+    for suffix, repl, fallback_ok in _DETACH:
         if not t.endswith(suffix) or len(t) - len(suffix) < 2:
             continue
         stem = t[: len(t) - len(suffix)] + repl
         for cand in _repair(stem):
             if cand in _LEXICON or cand in _IRREGULAR:
                 return _IRREGULAR.get(cand, cand)
-        if fallback is None and len(stem) >= 3:
+        if fallback is None and len(stem) >= 3 and fallback_ok:
             fallback = stem
     return fallback if fallback is not None else t
 

@@ -1,0 +1,181 @@
+"""Phase-split MFU measurement for the bench workloads (VERDICT r2 #2).
+
+Times each phase of the MNIST bench solve separately — featurize (fused
+single-gemm vs per-chain), Gram accumulation, Cholesky factor + refine —
+at matmul precision None (bf16 MXU passes) and "highest" (full f32), plus
+the TIMIT-shaped weighted solver phases. Emits one JSON dict (and writes
+MFU_SWEEP.json at the repo root) with achieved TFLOP/s per phase and the
+fraction of bf16 peak, so ROOFLINE.md can state per phase what the bound
+is and how close we run.
+
+Run ON CHIP (no JAX_PLATFORMS pin): phases are measured with the same
+async-dispatch/one-sync discipline as bench.py.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N = 60_000
+D_IMG = 784
+NUM_FFTS = 4
+D_FEAT = 2048
+CLASSES = 10
+
+PEAK_FLOPS = {"v5 lite": 197e12, "v5p": 459e12, "v4": 275e12}
+
+
+def _sync(x) -> float:
+    import jax
+
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    return float(np.asarray(leaf.ravel()[0]))
+
+
+def _timed(step, iters: int = 6) -> float:
+    _sync(step())
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(iters):
+        out = step()
+    _sync(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main() -> None:
+    plat = os.environ.get("JAX_PLATFORMS", "").split(",")[0]
+    import jax
+
+    if plat:
+        jax.config.update("jax_platforms", plat)
+    import jax.numpy as jnp
+
+    from keystone_tpu.core.runtime import enable_compilation_cache
+    from keystone_tpu.models import mnist_random_fft as m
+    from keystone_tpu.ops.linear import ridge_solve
+    from keystone_tpu.ops.weighted_linear import (
+        BlockWeightedLeastSquaresEstimator,
+    )
+
+    enable_compilation_cache()
+    dev = jax.devices()[0]
+    peak = next(
+        (v for k, v in PEAK_FLOPS.items() if k in dev.device_kind.lower()),
+        None,
+    )
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(N, D_IMG)).astype(np.float32))
+    feats = m.build_batch_featurizers(NUM_FFTS, D_FEAT, seed=0)
+    out: dict = {
+        "device_kind": dev.device_kind,
+        "backend": dev.platform,
+        "timestamp": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+        "phases": {},
+    }
+
+    def record(name, sec, flops):
+        tf = flops / sec / 1e12
+        out["phases"][name] = {
+            "ms": round(sec * 1e3, 3),
+            "tflops_per_s": round(tf, 2),
+            **(
+                {"frac_bf16_peak": round(tf * 1e12 / peak, 4)}
+                if peak
+                else {}
+            ),
+        }
+
+    # ---- featurize: fused single gemm vs per-chain path ----
+    feat_flops = 2 * N * D_IMG * (NUM_FFTS * 512)
+    sec = _timed(lambda: m.featurize(feats, x))
+    record("featurize_fused", sec, feat_flops)
+    sec = _timed(
+        lambda: [
+            m._featurize_batch(tuple(chains), x) for chains in feats
+        ]
+    )
+    record("featurize_chains", sec, feat_flops)
+
+    a = jnp.concatenate(m.featurize(feats, x), axis=1)  # (N, 2048)
+    _sync(a)
+    d_feat = int(a.shape[-1])
+    gram_flops = 2 * N * d_feat * d_feat
+
+    for prec in (None, "highest"):
+        tag = "bf16pass" if prec is None else "f32"
+        ctx = (
+            jax.default_matmul_precision(prec)
+            if prec
+            else __import__("contextlib").nullcontext()
+        )
+        with ctx:
+            gram = jax.jit(lambda a_: a_.T @ a_)
+            sec = _timed(lambda: gram(a))
+            record(f"gram_{tag}", sec, gram_flops)
+        g = gram(a)
+        _sync(g)
+        rhs = jnp.asarray(
+            rng.normal(size=(d_feat, CLASSES)).astype(np.float32)
+        )
+        solve = jax.jit(lambda g_, r_: ridge_solve(g_, r_, 1e-2))
+        sec = _timed(lambda: solve(g, rhs))
+        # cholesky d^3/3 + refine 2 * 2d^2C
+        record(
+            f"cholesky_refine_{tag}",
+            sec,
+            d_feat**3 / 3 + 4 * d_feat * d_feat * CLASSES,
+        )
+
+    # ---- TIMIT-shaped weighted solver, both precisions ----
+    n_w, d_w, c_w = 32_768, 1024, 147
+    cls = rng.integers(0, c_w, size=n_w)
+    centers = rng.normal(size=(c_w, d_w)).astype(np.float32)
+    aw = jnp.asarray(
+        (centers[cls] + rng.normal(size=(n_w, d_w))).astype(np.float32)
+    )
+    yw = -np.ones((n_w, c_w), np.float32)
+    yw[np.arange(n_w), cls] = 1.0
+    yw = jnp.asarray(yw)
+    l_pad = max(-(-int(np.bincount(cls).max()) // 64) * 64, 64)
+    lp1 = l_pad + 1
+    w_flops = (
+        2 * n_w * d_w * d_w * 2
+        + 2 * c_w * d_w * d_w * lp1
+        + 2 * c_w * d_w * lp1**2
+        + 2 * (2 * n_w * d_w * c_w + 8 * c_w * d_w * d_w)
+    )
+    for prec in (None, "highest"):
+        tag = "bf16pass" if prec is None else "f32"
+        est = BlockWeightedLeastSquaresEstimator(
+            block_size=d_w,
+            num_iter=2,
+            lam=1e-3,
+            mixture_weight=0.5,
+            class_chunk=16,
+            precision=prec,
+        )
+        sec = _timed(lambda e=est: e.fit(aw, yw), iters=2)
+        record(f"weighted_fit_{tag}", sec, w_flops)
+        out["phases"][f"weighted_fit_{tag}"]["samples_per_s"] = round(
+            n_w / sec, 1
+        )
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "MFU_SWEEP.json",
+    )
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
