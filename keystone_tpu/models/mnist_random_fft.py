@@ -172,6 +172,22 @@ def _load(conf: MnistRandomFFTConfig, which: str) -> LabeledData:
 
 
 def _load_mnist_csv(path: str) -> LabeledData:
+    from keystone_tpu.loaders.idx import (
+        guess_labels_path,
+        is_idx_path,
+        load_labeled_idx,
+    )
+
+    if is_idx_path(path):
+        # upstream MNIST ubyte distribution (0-indexed labels); labels
+        # file located by the conventional sibling name
+        labels = guess_labels_path(path)
+        if labels is None:
+            raise FileNotFoundError(
+                f"{path} looks like an IDX images file but no labels "
+                "sibling (…labels-idx1…) was found next to it"
+            )
+        return load_labeled_idx(path, labels)
     # the reference's MNIST csvs carry 1-indexed labels (MnistRandomFFT.scala)
     return load_labeled_csv(path, label_offset=1)
 

@@ -41,6 +41,41 @@ def _row_mask(n_rows: int, n_valid, dtype) -> jnp.ndarray:
     return (jnp.arange(n_rows) < n_valid)[:, None].astype(dtype)
 
 
+def _cho_factor_escalating(
+    m: jnp.ndarray, jitter: float, max_steps: int = 5
+):
+    """Cholesky with an escalating jitter floor: factor ``m + j·I``,
+    multiplying ``j`` by 32 until the factor is NaN-free (rank-deficient
+    Grams of large-scale features can be INDEFINITE at the f32 noise
+    level — a fixed 1e-6 jitter then produces a NaN factor, which without
+    this guard silently poisons the model into chance predictions).
+    Returns the (factor, jitter_used) pair; traced, so the retry costs
+    nothing when the first factorization is clean (the while_loop exits
+    after one iteration)."""
+    d = m.shape[0]
+    eye = jnp.eye(d, dtype=m.dtype)
+
+    def factor(j):
+        return jax.scipy.linalg.cho_factor(m + j * eye)[0]
+
+    def cond(state):
+        j, c, steps = state
+        return jnp.logical_and(
+            jnp.any(jnp.isnan(c)), steps < max_steps
+        )
+
+    def body(state):
+        j, _, steps = state
+        j = j * 32.0
+        return (j, factor(j), steps + 1)
+
+    j0 = jnp.asarray(jitter, m.dtype)
+    j, c, _ = jax.lax.while_loop(cond, body, (j0, factor(j0), 0))
+    # cho_factor's default layout is upper (lower=False); cho_solve needs
+    # the matching flag
+    return (c, False), j
+
+
 def ridge_solve(
     ata: jnp.ndarray,
     atb: jnp.ndarray,
@@ -58,17 +93,18 @@ def ridge_solve(
 
     - diagonal (Jacobi) equilibration of the Gram,
     - a relative ``jitter`` floor keeping the factorization positive even
-      when λ is tiny vs the Gram scale,
+      when λ is tiny vs the Gram scale, escalated ×32 until the factor is
+      NaN-free (rank-deficient N<d Grams of 255-scale inputs need more
+      than the base floor),
     - ``refine`` steps of iterative refinement against the *original*
       system, recovering the accuracy the equilibrated factor loses.
 
     Tiny replicated compute; runs identically on every chip.
     """
-    d = ata.shape[0]
     inv_s = jax.lax.rsqrt(jnp.clip(jnp.diagonal(ata), 1e-30, None))
     m = ata * (inv_s[:, None] * inv_s[None, :])
-    m = m + jnp.diag(lam * inv_s * inv_s) + jitter * jnp.eye(d, dtype=ata.dtype)
-    cf = jax.scipy.linalg.cho_factor(m)
+    m = m + jnp.diag(lam * inv_s * inv_s)
+    cf, _ = _cho_factor_escalating(m, jitter)
 
     def solve_prec(rhs):
         return inv_s[:, None] * jax.scipy.linalg.cho_solve(cf, rhs * inv_s[:, None])
@@ -101,12 +137,9 @@ def stabilized_cho_solve(mat: jnp.ndarray, jitter: float = 1e-6):
     O(d³) factorization once and every solve is triangular-substitution
     gemms. The returned fn maps (d, k) → (d, k).
     """
-    d = mat.shape[0]
     inv_s = jax.lax.rsqrt(jnp.clip(jnp.diagonal(mat), 1e-30, None))
-    m = mat * (inv_s[:, None] * inv_s[None, :]) + jitter * jnp.eye(
-        d, dtype=mat.dtype
-    )
-    cf = jax.scipy.linalg.cho_factor(m)
+    m = mat * (inv_s[:, None] * inv_s[None, :])
+    cf, _ = _cho_factor_escalating(m, jitter)
 
     def solve(rhs):
         return inv_s[:, None] * jax.scipy.linalg.cho_solve(

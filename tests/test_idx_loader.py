@@ -1,0 +1,80 @@
+"""IDX (MNIST ubyte) loader: the upstream corpus format, accepted
+directly by the MNIST pipeline so a driver-staged real MNIST runs with
+no conversion (PARITY real-data note)."""
+
+import gzip
+import struct
+
+import numpy as np
+
+from keystone_tpu.loaders.idx import (
+    guess_labels_path,
+    is_idx_path,
+    load_idx,
+    load_labeled_idx,
+)
+
+
+def _write_idx(path, arr, code):
+    with open(path, "wb") as f:
+        f.write(struct.pack(">HBB", 0, code, arr.ndim))
+        f.write(struct.pack(f">{arr.ndim}i", *arr.shape))
+        f.write(arr.astype(arr.dtype.newbyteorder(">")).tobytes())
+
+
+def _mnist_pair(tmp_path, n=12, gz=False):
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 256, size=(n, 28, 28)).astype(np.uint8)
+    labels = rng.integers(0, 10, size=(n,)).astype(np.uint8)
+    ip = tmp_path / "t10k-images-idx3-ubyte"
+    lp = tmp_path / "t10k-labels-idx1-ubyte"
+    _write_idx(ip, imgs, 0x08)
+    _write_idx(lp, labels, 0x08)
+    if gz:
+        for p in (ip, lp):
+            with open(p, "rb") as f:
+                raw = f.read()
+            with gzip.open(str(p) + ".gz", "wb") as f:
+                f.write(raw)
+        return str(ip) + ".gz", str(lp) + ".gz", imgs, labels
+    return str(ip), str(lp), imgs, labels
+
+
+def test_load_idx_roundtrip(tmp_path):
+    ip, lp, imgs, labels = _mnist_pair(tmp_path)
+    assert is_idx_path(ip) and is_idx_path(lp)
+    np.testing.assert_array_equal(load_idx(ip), imgs)
+    np.testing.assert_array_equal(load_idx(lp), labels)
+
+
+def test_load_labeled_idx_and_sibling(tmp_path):
+    ip, lp, imgs, labels = _mnist_pair(tmp_path, gz=True)
+    assert guess_labels_path(ip) == lp
+    data = load_labeled_idx(ip, lp)
+    assert data.data.shape == (12, 784)
+    np.testing.assert_array_equal(data.labels, labels.astype(np.int32))
+    np.testing.assert_allclose(
+        data.data[0], imgs[0].reshape(-1).astype(np.float32)
+    )
+
+
+def test_mnist_pipeline_accepts_idx(tmp_path):
+    ip, lp, _, labels = _mnist_pair(tmp_path, n=40)
+    from keystone_tpu.models.mnist_random_fft import _load_mnist_csv
+
+    data = _load_mnist_csv(ip)
+    assert data.data.shape == (40, 784)
+    np.testing.assert_array_equal(data.labels, labels.astype(np.int32))
+
+
+def test_is_idx_rejects_csv(tmp_path):
+    p = tmp_path / "x.csv"
+    p.write_text("1,2,3\n4,5,6\n")
+    assert not is_idx_path(str(p))
+
+
+def test_sibling_lookup_with_images_in_directory_name(tmp_path):
+    d = tmp_path / "mnist-images"
+    d.mkdir()
+    ip, lp, _, _ = _mnist_pair(d)
+    assert guess_labels_path(ip) == lp

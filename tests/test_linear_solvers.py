@@ -287,3 +287,45 @@ def test_fit_sweep_chunked_matches_unchunked(rng):
             np.testing.assert_allclose(
                 np.asarray(x1), np.asarray(x2), atol=1e-5
             )
+
+
+def test_ridge_solve_rank_deficient_large_scale(rng):
+    """N < d Gram of 255-scale one-sided (relu-like) features: the
+    equilibrated matrix is indefinite at f32 noise scale and a fixed
+    jitter NaN'd the Cholesky, silently producing chance predictions.
+    The escalating-jitter factor must stay finite and fit the rows."""
+    from keystone_tpu.ops.linear import ridge_solve
+
+    n, d = 200, 512
+    base = np.maximum(rng.normal(size=(n, d)), 0).astype(np.float32) * 255
+    a_c = (base - base.mean(0)).astype(np.float32)
+    y = rng.normal(size=(n, 5)).astype(np.float32)
+    ata = jnp.asarray(a_c.T @ a_c)
+    atb = jnp.asarray(a_c.T @ y)
+    x = np.asarray(ridge_solve(ata, atb, 1e-4))
+    assert np.isfinite(x).all()
+    resid = a_c @ x - y
+    # interpolation regime: the fit must capture most of the target
+    assert np.abs(resid).max() < 0.25 * np.abs(y).max(), np.abs(resid).max()
+
+
+def test_bcd_fit_underdetermined_large_scale(rng):
+    """End-to-end: the block solver on N<d 255-scale features must
+    produce a model that separates well-separated classes (this was
+    chance-level before the escalating-jitter fix)."""
+    from keystone_tpu.ops.util import ClassLabelIndicators, MaxClassifier
+
+    n, d, c = 300, 768, 10
+    cls = rng.integers(0, c, size=n)
+    centers = rng.integers(0, 255, size=(c, d)).astype(np.float32)
+    a = np.clip(
+        centers[cls] + rng.integers(-30, 30, size=(n, d)), 0, 255
+    ).astype(np.float32)
+    est = BlockLeastSquaresEstimator(block_size=d, num_iter=1, lam=1e-4)
+    model = est.fit(
+        [jnp.asarray(a)],
+        ClassLabelIndicators(num_classes=c)(jnp.asarray(cls)),
+    )
+    pred = np.asarray(MaxClassifier()(model([jnp.asarray(a)])))
+    assert np.isfinite(np.asarray(model.xs[0])).all()
+    assert (pred != cls).mean() < 0.05, (pred != cls).mean()

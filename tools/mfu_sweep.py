@@ -117,22 +117,26 @@ def main() -> None:
             else __import__("contextlib").nullcontext()
         )
         with ctx:
+            # everything precision-sensitive must be TRACED inside the
+            # context (matmul precision is baked in at trace time — a
+            # solve traced after the with-block would silently measure
+            # default precision under an f32 label)
             gram = jax.jit(lambda a_: a_.T @ a_)
             sec = _timed(lambda: gram(a))
             record(f"gram_{tag}", sec, gram_flops)
-        g = gram(a)
-        _sync(g)
-        rhs = jnp.asarray(
-            rng.normal(size=(d_feat, CLASSES)).astype(np.float32)
-        )
-        solve = jax.jit(lambda g_, r_: ridge_solve(g_, r_, 1e-2))
-        sec = _timed(lambda: solve(g, rhs))
-        # cholesky d^3/3 + refine 2 * 2d^2C
-        record(
-            f"cholesky_refine_{tag}",
-            sec,
-            d_feat**3 / 3 + 4 * d_feat * d_feat * CLASSES,
-        )
+            g = gram(a)
+            _sync(g)
+            rhs = jnp.asarray(
+                rng.normal(size=(d_feat, CLASSES)).astype(np.float32)
+            )
+            solve = jax.jit(lambda g_, r_: ridge_solve(g_, r_, 1e-2))
+            sec = _timed(lambda: solve(g, rhs))
+            # cholesky d^3/3 + refine 2 * 2d^2C
+            record(
+                f"cholesky_refine_{tag}",
+                sec,
+                d_feat**3 / 3 + 4 * d_feat * d_feat * CLASSES,
+            )
 
     # ---- TIMIT-shaped weighted solver, both precisions ----
     n_w, d_w, c_w = 32_768, 1024, 147
