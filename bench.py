@@ -519,9 +519,15 @@ def main() -> None:
             raise
         print(line)
         return
-    cpu_rate = bench_cpu_numpy(labels[:CPU_SUBSET], data[:CPU_SUBSET], N_TRAIN)
-    cpu_cifar = bench_cpu_cifar_conv()
-    cpu_weighted = bench_cpu_weighted()
+    # best-of-3 CPU baselines: the shared host's load varies between
+    # sessions (~3x observed across rounds); the MAX rate is the honest
+    # comparison point and the stable one
+    cpu_rate = max(
+        bench_cpu_numpy(labels[:CPU_SUBSET], data[:CPU_SUBSET], N_TRAIN)
+        for _ in range(3)
+    )
+    cpu_cifar = max(bench_cpu_cifar_conv() for _ in range(3))
+    cpu_weighted = max(bench_cpu_weighted() for _ in range(3))
     metric = "mnist_random_fft featurize+fit samples/sec"
     if fallback:
         metric += " [CPU FALLBACK: accelerator unreachable]"

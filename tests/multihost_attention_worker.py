@@ -1,0 +1,78 @@
+"""Worker for the 2-process ring/Ulysses attention parity test.
+
+Long-context sequence parallelism across a REAL process boundary: the
+sequence axis is sharded over a 4-device global mesh spanning two OS
+processes, so the ring's ppermute hops (and Ulysses' all_to_alls) cross
+gloo — the CPU stand-in for ICI/DCN — exactly like a multi-host TPU pod.
+
+Usage: python multihost_attention_worker.py <pid> <nprocs> <port> <out>
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> None:
+    pid, nprocs, port, out_path = (
+        int(sys.argv[1]),
+        int(sys.argv[2]),
+        sys.argv[3],
+        sys.argv[4],
+    )
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from keystone_tpu.ops.attention import ring_attention, ulysses_attention
+    from keystone_tpu.parallel import multihost
+    from keystone_tpu.parallel.mesh import create_mesh
+
+    multihost.initialize(
+        coordinator_address=f"localhost:{port}",
+        num_processes=nprocs,
+        process_id=pid,
+    )
+    n_dev = jax.device_count()
+    b, h, s, d = 2, 4, 64 * n_dev, 16
+    rng = np.random.default_rng(0)
+    q, k, v = (
+        rng.normal(size=(b, h, s, d)).astype(np.float32) for _ in range(3)
+    )
+
+    mesh = create_mesh(data=n_dev)
+    sharding = NamedSharding(mesh, P(None, None, "data", None))
+    replicated = NamedSharding(mesh, P())
+    shard = s // nprocs
+
+    def to_global(x):
+        return jax.make_array_from_process_local_data(
+            sharding, x[:, :, pid * shard : (pid + 1) * shard, :]
+        )
+
+    def replicate(x):
+        # cross-process allgather via a resharding jit: the result is
+        # fully addressable on every process
+        return np.asarray(jax.jit(lambda a: a, out_shardings=replicated)(x))
+
+    qg, kg, vg = to_global(q), to_global(k), to_global(v)
+    outs = {}
+    for causal in (False, True):
+        outs[f"ring_causal{causal}"] = replicate(
+            ring_attention(qg, kg, vg, mesh, causal=causal)
+        )
+        outs[f"ulysses_causal{causal}"] = replicate(
+            ulysses_attention(qg, kg, vg, mesh, causal=causal)
+        )
+    if pid == 0:
+        np.savez(out_path, q=q, k=k, v=v, **outs)
+    print(f"attention worker {pid}: ok", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -3,11 +3,10 @@
 The reference scales out with spark-submit over a cluster
 (``bin/run-pipeline.sh:16-26``, ``bin/pipelines-ec2.sh``); the TPU-native
 equivalent is one SPMD program per host joined by
-``jax.distributed.initialize``. This test runs that path for real: two OS
+``jax.distributed.initialize``. These tests run that path for real: two OS
 processes (2 virtual CPU devices each → a 4-device global mesh), global
-arrays assembled from process-local rows, a sharded solver fit whose Gram
-psums cross the process boundary via gloo — and the result must equal the
-single-process fit bit-for-bit-close.
+arrays assembled from process-local rows, collectives crossing the
+process boundary via gloo — and results must equal single-process.
 """
 
 import os
@@ -19,37 +18,37 @@ from pathlib import Path
 import numpy as np
 
 WORKER = Path(__file__).with_name("multihost_worker.py")
+ATTN_WORKER = Path(__file__).with_name("multihost_attention_worker.py")
 
 
-def test_two_process_fit_matches_single_process(tmp_path, free_tcp_port):
-    out = tmp_path / "model.npz"
-    nprocs = 2
-    procs = []
+def _run_workers(worker: Path, out, port, nprocs: int = 2) -> list[str]:
+    """Launch one SPMD worker per process, wait, return collected logs;
+    asserts every worker exited 0."""
     env = dict(os.environ)
     # the workers pin their own platform/device-count env; drop the test
     # session's 8-device flag so each worker gets exactly 2 devices
     env.pop("XLA_FLAGS", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = os.pathsep.join(
-        p for p in (str(WORKER.parent.parent), env.get("PYTHONPATH")) if p
+        p for p in (str(worker.parent.parent), env.get("PYTHONPATH")) if p
     )
-    for pid in range(nprocs):
-        procs.append(
-            subprocess.Popen(
-                [
-                    sys.executable,
-                    str(WORKER),
-                    str(pid),
-                    str(nprocs),
-                    str(free_tcp_port),
-                    str(out),
-                ],
-                env=env,
-                stdout=subprocess.PIPE,
-                stderr=subprocess.STDOUT,
-                text=True,
-            )
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable,
+                str(worker),
+                str(pid),
+                str(nprocs),
+                str(port),
+                str(out),
+            ],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
         )
+        for pid in range(nprocs)
+    ]
     deadline = time.monotonic() + 300
     logs = []
     for p in procs:
@@ -63,6 +62,12 @@ def test_two_process_fit_matches_single_process(tmp_path, free_tcp_port):
             raise
         logs.append(stdout)
         assert p.returncode == 0, f"worker failed:\n{stdout}"
+    return logs
+
+
+def test_two_process_fit_matches_single_process(tmp_path, free_tcp_port):
+    out = tmp_path / "model.npz"
+    logs = _run_workers(WORKER, out, free_tcp_port)
     assert out.exists(), "process 0 wrote no model\n" + "\n".join(logs)
 
     # single-process reference fit on the same deterministic dataset
@@ -86,3 +91,35 @@ def test_two_process_fit_matches_single_process(tmp_path, free_tcp_port):
     for i, rx in enumerate(ref_xs):
         np.testing.assert_allclose(got[f"x{i}"], rx, atol=2e-4)
     np.testing.assert_allclose(got["b"], np.asarray(ref.b), atol=2e-4)
+
+
+def test_two_process_ring_and_ulysses_match_dense(tmp_path, free_tcp_port):
+    """Sequence/context parallelism across a real process boundary
+    (SURVEY §2.11 SP/CP + comm backend): ring ppermute hops and Ulysses
+    all_to_alls cross gloo between two OS processes, and both must equal
+    single-process dense attention."""
+    out = tmp_path / "attn.npz"
+    logs = _run_workers(ATTN_WORKER, out, free_tcp_port)
+    assert out.exists(), "no attention output\n" + "\n".join(logs)
+
+    got = np.load(out)
+    q, k, v = got["q"], got["k"], got["v"]
+
+    def dense(causal):
+        s = q.shape[2]
+        logits = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(q.shape[-1])
+        if causal:
+            mask = np.tril(np.ones((s, s), bool))
+            logits = np.where(mask, logits, -np.inf)
+        w = np.exp(logits - logits.max(-1, keepdims=True))
+        w /= w.sum(-1, keepdims=True)
+        return np.einsum("bhqk,bhkd->bhqd", w, v)
+
+    for causal in (False, True):
+        want = dense(causal)
+        for name in ("ring", "ulysses"):
+            gotten = got[f"{name}_causal{causal}"]
+            np.testing.assert_allclose(
+                gotten, want, atol=2e-4,
+                err_msg=f"{name} causal={causal}",
+            )
