@@ -76,10 +76,49 @@ def _cho_factor_escalating(
     return (c, False), j
 
 
+def ridge_factor(
+    ata: jnp.ndarray, lam, jitter: float = 1e-6
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Equilibrated escalating-jitter Cholesky of ``AᵀA + λI`` as plain
+    arrays ``(c, inv_s)`` — vmappable, hoistable out of solve loops (the
+    TPU factorization is sequential-panel latency; BCD re-solves the same
+    Gram every pass, so factoring once per fit instead of once per pass
+    removes the dominant fixed cost of multi-pass fits)."""
+    inv_s = jax.lax.rsqrt(jnp.clip(jnp.diagonal(ata), 1e-30, None))
+    m = ata * (inv_s[:, None] * inv_s[None, :])
+    m = m + jnp.diag(lam * inv_s * inv_s)
+    cf, _ = _cho_factor_escalating(m, jitter)
+    return cf[0], inv_s
+
+
+def ridge_solve_prefactored(
+    factor: tuple[jnp.ndarray, jnp.ndarray],
+    ata: jnp.ndarray,
+    atb: jnp.ndarray,
+    lam,
+    refine: int = 2,
+) -> jnp.ndarray:
+    """Solve with a :func:`ridge_factor` result; refinement targets the
+    ORIGINAL system so the equilibrated/jittered factor's error is
+    recovered exactly as in :func:`ridge_solve`."""
+    c, inv_s = factor
+
+    def solve_prec(rhs):
+        return inv_s[:, None] * jax.scipy.linalg.cho_solve(
+            (c, False), rhs * inv_s[:, None]
+        )
+
+    x = solve_prec(atb)
+    for _ in range(refine):
+        r = atb - (ata @ x + lam * x)
+        x = x + solve_prec(r)
+    return x
+
+
 def ridge_solve(
     ata: jnp.ndarray,
     atb: jnp.ndarray,
-    lam: float,
+    lam,
     refine: int = 2,
     jitter: float = 1e-6,
 ) -> jnp.ndarray:
@@ -101,19 +140,9 @@ def ridge_solve(
 
     Tiny replicated compute; runs identically on every chip.
     """
-    inv_s = jax.lax.rsqrt(jnp.clip(jnp.diagonal(ata), 1e-30, None))
-    m = ata * (inv_s[:, None] * inv_s[None, :])
-    m = m + jnp.diag(lam * inv_s * inv_s)
-    cf, _ = _cho_factor_escalating(m, jitter)
-
-    def solve_prec(rhs):
-        return inv_s[:, None] * jax.scipy.linalg.cho_solve(cf, rhs * inv_s[:, None])
-
-    x = solve_prec(atb)
-    for _ in range(refine):
-        r = atb - (ata @ x + lam * x)
-        x = x + solve_prec(r)
-    return x
+    return ridge_solve_prefactored(
+        ridge_factor(ata, lam, jitter), ata, atb, lam, refine
+    )
 
 
 def _matmul_precision(precision: str | None):
@@ -137,13 +166,11 @@ def stabilized_cho_solve(mat: jnp.ndarray, jitter: float = 1e-6):
     O(d³) factorization once and every solve is triangular-substitution
     gemms. The returned fn maps (d, k) → (d, k).
     """
-    inv_s = jax.lax.rsqrt(jnp.clip(jnp.diagonal(mat), 1e-30, None))
-    m = mat * (inv_s[:, None] * inv_s[None, :])
-    cf, _ = _cho_factor_escalating(m, jitter)
+    c, inv_s = ridge_factor(mat, 0.0, jitter)
 
     def solve(rhs):
         return inv_s[:, None] * jax.scipy.linalg.cho_solve(
-            cf, rhs * inv_s[:, None]
+            (c, False), rhs * inv_s[:, None]
         )
 
     return solve
@@ -385,11 +412,14 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         lams_arr = jnp.asarray(lams, jnp.float32)
         n_lam = int(lams_arr.shape[0])
         if sweep_chunk is None:
+            itemsize = blocks[0].dtype.itemsize
+            # per-λ liveness: the (N, C) residual slice PLUS the hoisted
+            # per-(block, λ) Cholesky factors (Σ d_block² — resident for
+            # the whole sweep since round 3's factor hoisting)
             per_lam = (
-                blocks[0].shape[0]
-                * labels.shape[-1]
-                * blocks[0].dtype.itemsize
-            )
+                blocks[0].shape[0] * labels.shape[-1]
+                + sum(b.shape[-1] ** 2 for b in blocks)
+            ) * itemsize
             sweep_chunk = max(1, min(n_lam, (2 << 30) // max(per_lam, 1)))
         # _bcd_fit_sweep is jitted: an uneven tail chunk (2,2,1) would
         # recompile the whole sweep program for the odd shape. Pad the
@@ -457,6 +487,14 @@ def _bcd_fit_sweep(blocks: tuple, labels, n_valid, lams, num_iter: int):
         (labels - b_mean) * mask, (n_lam,) + labels.shape
     ).astype(dtype)
 
+    # batched per-(block, λ) factors, computed ONCE per sweep: factors
+    # are pass-invariant, and the TPU factorization is the latency floor
+    # (costs L·d_block² extra HBM per block — bounded by fit_sweep's
+    # sweep chunking)
+    factors = [
+        jax.vmap(lambda l, g=g: ridge_factor(g, l))(lams) for g in grams
+    ]
+
     def one_pass(_p, state):
         xs, resid = state
         xs = list(xs)
@@ -465,8 +503,10 @@ def _bcd_fit_sweep(blocks: tuple, labels, n_valid, lams, num_iter: int):
                 "de,lec->ldc", grams[i], xs[i]
             )
             x_new = jax.vmap(
-                lambda r, l, g=grams[i]: ridge_solve(g, r, l)
-            )(rhs, lams)
+                lambda f, r, l, g=grams[i]: ridge_solve_prefactored(
+                    f, g, r, l
+                )
+            )(factors[i], rhs, lams)
             resid = resid - jnp.einsum("nd,ldc->lnc", a_c, x_new - xs[i])
             xs[i] = x_new
         return tuple(xs), resid
@@ -495,10 +535,13 @@ def _bcd_fit(
     for a_c, x in zip(centered, xs):
         resid = resid - a_c @ x
 
+    # factor each block's Gram ONCE per fit — TPU factorizations are
+    # sequential-panel latency, and every pass re-solves the same system
+    factors = [ridge_factor(g, lam) for g in grams]
     for _ in range(num_iter):
         for i, a_c in enumerate(centered):
             rhs = a_c.T @ resid + grams[i] @ xs[i]
-            x_new = ridge_solve(grams[i], rhs, lam)
+            x_new = ridge_solve_prefactored(factors[i], grams[i], rhs, lam)
             resid = resid - a_c @ (x_new - xs[i])
             xs[i] = x_new
 
