@@ -46,8 +46,15 @@ from keystone_tpu.ops.linear import (
     _matmul_precision,
     _row_mask,
     _split_blocks,
+    ridge_factor,
     ridge_solve,
+    ridge_solve_prefactored,
 )
+
+# per-block HBM budget (bytes) for hoisting the dense path's
+# pass-invariant per-class systems + factors out of the BCD loop:
+# 2 · C · d_block² · 4B must fit alongside the rest of the fit
+_DENSE_HOIST_BUDGET = 2 << 30
 
 
 @treenode
@@ -145,6 +152,37 @@ def _class_sorted_perm(
         seg = order[offsets[ci] : offsets[ci + 1]]
         perm[ci, : len(seg)] = seg
     return perm
+
+
+def _chunk_joint_xtx(s, a_m, pop_cov, pop_mean, class_l, dtype, w):
+    """One chunk's per-class systems (S, d, d):
+    (1−w)·pop_cov + w·class_cov + w(1−w)·md mdᵀ, with class_cov from
+    CENTERED rows in grid mode (no g/n_c − μμᵀ cancellation; sentinel
+    slots are zero rows that centering would turn into −μ, so they are
+    masked out) and the onehot masked reduction in fallback mode."""
+    mu = s["class_mean"]  # (S, d)
+    if class_l is not None:
+        valid = (
+            jnp.arange(class_l)[None, :] < s["n_c"][:, None]
+        ).astype(dtype)  # (S, L)
+        rows_c = (s["a_rows"] - mu[:, None, :]) * valid[:, :, None]
+        class_cov = (
+            jnp.einsum("sld,sle->sde", rows_c, rows_c)
+            / s["n_c"][:, None, None]
+        )
+    else:
+        # masked full-batch reduction: C·N·d²; no row gather available,
+        # so this keeps the subtraction form
+        g = jnp.einsum("nd,ns,ne->sde", a_m, s["onehot"], a_m)
+        class_cov = g / s["n_c"][:, None, None] - jnp.einsum(
+            "sd,se->sde", mu, mu
+        )
+    md = mu - pop_mean  # (S, d)
+    return (
+        (1 - w) * pop_cov[None]
+        + w * class_cov
+        + w * (1 - w) * jnp.einsum("sd,se->sde", md, md)
+    )
 
 
 @partial(
@@ -320,22 +358,35 @@ def _weighted_bcd_fit(
         class_l is not None and class_l + 2 <= a.shape[-1] // 2
         for a in blocks
     ]
+
+    def class_static_stats(a_m):
+        """Chunked pass-invariant per-class stats shared by the Woodbury
+        prep, the dense prep, and the in-loop fallback: class means,
+        counts, and the class rows (grid) or one-hot columns (masked)."""
+        static = {
+            "class_mean": pad_classes(
+                class_sum(a_m) / n_c_safe[:, None], 0
+            ).reshape(n_chunks, class_chunk, -1),
+            "n_c": pad_classes(n_c_safe, 0).reshape(n_chunks, class_chunk),
+        }
+        if class_l is not None:
+            static["a_rows"] = pad_classes(
+                a_m.reshape(c, class_l, -1), 0
+            ).reshape(n_chunks, class_chunk, class_l, -1)
+        else:
+            oh_chunks = pad_classes(onehot, 1).reshape(
+                n_rows, n_chunks, class_chunk
+            )
+            static["onehot"] = jnp.moveaxis(oh_chunks, 1, 0)
+        return static
+
     wood_pre = []
     for i, a in enumerate(blocks):
         if not use_woodbury[i]:
             wood_pre.append(None)
             continue
         a_m = a * mask
-        class_mean = class_sum(a_m) / n_c_safe[:, None]  # (C, d)
-        static = {
-            "class_mean": pad_classes(class_mean, 0).reshape(
-                n_chunks, class_chunk, -1
-            ),
-            "n_c": pad_classes(n_c_safe, 0).reshape(n_chunks, class_chunk),
-            "a_rows": pad_classes(a_m.reshape(c, class_l, -1), 0).reshape(
-                n_chunks, class_chunk, class_l, -1
-            ),
-        }
+        static = class_static_stats(a_m)
         lp1 = class_l + 1
 
         def prep_chunk(s, b_inv=b_invs[i], pop_mean=pop_means[i], lp1=lp1):
@@ -387,6 +438,45 @@ def _weighted_bcd_fit(
             return {"v": v, "y": y, "ginv": ginv}
 
         wood_pre.append(jax.lax.map(prep_chunk, static))
+
+    # DENSE-path hoisting: the per-class systems (class Grams + joint_xtx
+    # + their factorizations) are pass-invariant too; for multi-pass fits
+    # build them ONCE per fit when the 2·C·d² resident bytes fit the
+    # budget (real TIMIT runs ~20 passes through this path — without
+    # hoisting every pass repays the N·d² Grams AND the batched d³
+    # factorizations)
+    # the budget covers the AGGREGATE (every hoisted block's systems +
+    # factors stay resident for the whole fit), so each eligible block
+    # gets an equal share
+    n_dense_candidates = sum(
+        1 for u in use_woodbury if not u
+    ) if num_iter > 1 else 0
+    per_block_budget = _DENSE_HOIST_BUDGET // max(n_dense_candidates, 1)
+    dense_pre = []
+    for i, a in enumerate(blocks):
+        d_blk = a.shape[-1]
+        hoist = (
+            not use_woodbury[i]
+            and num_iter > 1
+            and 2 * c_pad * d_blk * d_blk * np.dtype(dtype).itemsize
+            <= per_block_budget
+        )
+        if not hoist:
+            dense_pre.append(None)
+            continue
+        a_m = a * mask
+        static = class_static_stats(a_m)
+
+        def prep_dense(
+            s, a_m=a_m, pop_cov=pop_covs[i], pop_mean=pop_means[i]
+        ):
+            jxtx = _chunk_joint_xtx(
+                s, a_m, pop_cov, pop_mean, class_l, dtype, w
+            )
+            fc, fs = jax.vmap(lambda m_: ridge_factor(m_, lam))(jxtx)
+            return {"jxtx": jxtx, "c": fc, "s": fs}
+
+        dense_pre.append(jax.lax.map(prep_dense, static))
 
     # one full BCD sweep (every block) per fori_loop step: the program is
     # traced/compiled ONCE per block regardless of num_iter (an unrolled
@@ -466,68 +556,37 @@ def _weighted_bcd_fit(
             else:
                 # dense per-class normal equations (big classes or the
                 # traced-label masked fallback)
-                class_mean = class_sum(a_m) / n_c_safe[:, None]  # (C, d)
-                stats["class_mean"] = pad_classes(class_mean, 0).reshape(
-                    n_chunks, class_chunk, -1
-                )
-                stats["n_c"] = pad_classes(n_c_safe, 0).reshape(
-                    n_chunks, class_chunk
-                )
-                if class_l is not None:
-                    # class-sorted rows: the chunk's own rows as
-                    # (S, L, d) — per-class Grams are batched gemms
-                    stats["a_rows"] = pad_classes(
-                        a_m.reshape(c, class_l, -1), 0
-                    ).reshape(n_chunks, class_chunk, class_l, -1)
+                if dense_pre[i] is not None:
+                    # pass-invariant per-class systems hoisted: the
+                    # per-pass work is rhs assembly + prefactored solves
+                    def solve_chunk(args):
+                        pre, s = args
+                        return jax.vmap(
+                            lambda fc, fs, m_, r_: ridge_solve_prefactored(
+                                (fc, fs), m_, r_[:, None], lam
+                            )[:, 0]
+                        )(pre["c"], pre["s"], pre["jxtx"], chunk_rhs(s))
+
+                    deltas = jax.lax.map(
+                        solve_chunk, (dense_pre[i], stats)
+                    )
                 else:
-                    oh_chunks = pad_classes(onehot, 1).reshape(
-                        n_rows, n_chunks, class_chunk
-                    )
-                    stats["onehot"] = jnp.moveaxis(oh_chunks, 1, 0)
+                    stats.update(class_static_stats(a_m))
 
-                def solve_chunk(
-                    s, a_m=a_m, pop_cov=pop_cov, pop_mean=pop_mean
-                ):
-                    mu = s["class_mean"]  # (S, d)
-                    if class_l is not None:
-                        # (S, L, d) → (S, d, d): N·d² total across
-                        # chunks, from CENTERED rows — no g/n_c − μμᵀ
-                        # cancellation (see pop_cov comment above);
-                        # sentinel slots are zero rows that centering
-                        # would turn into −μ, so mask them out
-                        valid = (
-                            jnp.arange(class_l)[None, :]
-                            < s["n_c"][:, None]
-                        ).astype(dtype)  # (S, L)
-                        rows_c = (
-                            s["a_rows"] - mu[:, None, :]
-                        ) * valid[:, :, None]
-                        class_cov = (
-                            jnp.einsum("sld,sle->sde", rows_c, rows_c)
-                            / s["n_c"][:, None, None]
+                    def solve_chunk(
+                        s, a_m=a_m, pop_cov=pop_cov, pop_mean=pop_mean
+                    ):
+                        joint_xtx = _chunk_joint_xtx(
+                            s, a_m, pop_cov, pop_mean, class_l, dtype, w
                         )
-                    else:
-                        # masked full-batch reduction: C·N·d²; no row
-                        # gather available, so this keeps the
-                        # subtraction form
-                        g = jnp.einsum(
-                            "nd,ns,ne->sde", a_m, s["onehot"], a_m
-                        )
-                        class_cov = g / s["n_c"][
-                            :, None, None
-                        ] - jnp.einsum("sd,se->sde", mu, mu)
-                    md = mu - pop_mean  # (S, d)
-                    joint_xtx = (
-                        (1 - w) * pop_cov[None]
-                        + w * class_cov
-                        + w * (1 - w) * jnp.einsum("sd,se->sde", md, md)
-                    )
-                    delta = jax.vmap(
-                        lambda m, r: ridge_solve(m, r[:, None], lam)[:, 0]
-                    )(joint_xtx, chunk_rhs(s))
-                    return delta  # (S, d)
+                        delta = jax.vmap(
+                            lambda m, r: ridge_solve(m, r[:, None], lam)[
+                                :, 0
+                            ]
+                        )(joint_xtx, chunk_rhs(s))
+                        return delta  # (S, d)
 
-                deltas = jax.lax.map(solve_chunk, stats)  # (K, S, d)
+                    deltas = jax.lax.map(solve_chunk, stats)  # (K, S, d)
 
             delta = deltas.reshape(c_pad, -1)[:c].T  # (d, C)
             xs[i] = xs[i] + delta
