@@ -30,6 +30,7 @@ Spark's lazy RDD DAG used to be.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable
 
 import jax
@@ -304,6 +305,19 @@ class ChainedEstimator(Estimator):
         model = self.est.fit(self.prefix(data), **kw)
         return Pipeline.of(self.prefix, model)
 
+    def fit_fused(self, data, **kw) -> Pipeline:
+        """Featurize + fit traced as ONE XLA program.
+
+        ``fit`` runs the prefix and the estimator's fit as separate
+        dispatches; here both stages are traced together, so XLA can
+        fuse across the boundary and the host pays a single launch —
+        which matters both for launch-latency-sensitive links and for
+        letting the featurize output stay in HBM without a round trip
+        through a materialized intermediate.
+        """
+        model = _fused_fit(self, data, None, _kw_key(kw))
+        return Pipeline.of(self.prefix, model)
+
 
 @treenode
 class ChainedLabelEstimator(LabelEstimator):
@@ -315,6 +329,26 @@ class ChainedLabelEstimator(LabelEstimator):
     def fit(self, data, labels, **kw) -> Pipeline:
         model = self.est.fit(self.prefix(data), labels, **kw)
         return Pipeline.of(self.prefix, model)
+
+    def fit_fused(self, data, labels, **kw) -> Pipeline:
+        """Featurize + fit traced as ONE XLA program (see
+        :meth:`ChainedEstimator.fit_fused`)."""
+        model = _fused_fit(self, data, labels, _kw_key(kw))
+        return Pipeline.of(self.prefix, model)
+
+
+def _kw_key(kw: dict) -> tuple:
+    """Fit kwargs as a hashable jit-static key (values must be simple
+    python config — ints/floats/strings — not arrays)."""
+    return tuple(sorted(kw.items()))
+
+
+@functools.partial(jax.jit, static_argnames=("kw",))
+def _fused_fit(chained, data, labels, kw):
+    feats = chained.prefix(data)
+    if labels is None:
+        return chained.est.fit(feats, **dict(kw))
+    return chained.est.fit(feats, labels, **dict(kw))
 
 
 class FunctionNode(_Chainable):

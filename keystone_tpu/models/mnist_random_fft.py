@@ -23,7 +23,8 @@ import numpy as np
 
 from keystone_tpu.core.config import arg, parse_config
 from keystone_tpu.core.logging import get_logger
-from keystone_tpu.core.pipeline import Pipeline
+from keystone_tpu.core.pipeline import Pipeline, Transformer
+from keystone_tpu.core.treenode import treenode
 from keystone_tpu.loaders.csv_loader import load_labeled_csv
 from keystone_tpu.loaders.labeled import LabeledData
 from keystone_tpu.ops.linear import BlockLeastSquaresEstimator
@@ -86,6 +87,30 @@ def build_batch_featurizers(
 @jax.jit
 def _featurize_batch(chains: tuple, data):
     return ZipVectors()([chain(data) for chain in chains])
+
+
+@treenode
+class FeaturizerBank(Transformer):
+    """The full random-FFT featurizer as one Transformer: applies every
+    feature batch and returns the list of (N, ≤block_size) blocks.
+
+    Being a treenode Transformer lets the whole featurize+fit run as a
+    single traced program via ``ChainedLabelEstimator.fit_fused`` — the
+    block solver consumes the block list directly, so featurize output
+    never round-trips through a host dispatch boundary.
+    """
+
+    batches: tuple  # tuple of tuples of (sign → fft → relu) Pipelines
+
+    @staticmethod
+    def create(
+        num_ffts: int, block_size: int, seed: int, image_size: int = IMAGE_SIZE
+    ) -> "FeaturizerBank":
+        groups = build_batch_featurizers(num_ffts, block_size, seed, image_size)
+        return FeaturizerBank(batches=tuple(tuple(g) for g in groups))
+
+    def __call__(self, data):
+        return featurize(self.batches, data)
 
 
 def _sign_fft_relu_parts(chain):

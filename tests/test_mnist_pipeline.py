@@ -73,3 +73,30 @@ def test_fused_featurize_matches_chain_path(rng):
     np.testing.assert_allclose(
         np.asarray(out[0]), np.asarray(unfused), atol=2e-4
     )
+
+
+def test_featurizer_bank_fused_fit_parity(rng):
+    """FeaturizerBank >> solver traced as one program matches the eager
+    featurize-then-fit path exactly."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.core.pipeline import ChainedLabelEstimator
+    from keystone_tpu.ops.linear import BlockLeastSquaresEstimator
+    from keystone_tpu.ops.util import ClassLabelIndicators
+
+    x = jnp.asarray(rng.normal(size=(128, 64)).astype(np.float32))
+    y = ClassLabelIndicators(num_classes=5)(rng.integers(0, 5, size=128))
+    bank = m.FeaturizerBank.create(2, 256, seed=0, image_size=64)
+    est = BlockLeastSquaresEstimator(block_size=256, num_iter=1, lam=1e-1)
+
+    blocks = bank(x)
+    eager = est.fit(blocks, y, n_valid=120)
+    fused = ChainedLabelEstimator(prefix=bank, est=est).fit_fused(
+        x, y, n_valid=120
+    )
+    np.testing.assert_allclose(
+        np.asarray(eager(blocks)),
+        np.asarray(fused(x)),
+        rtol=2e-5,
+        atol=2e-5,
+    )

@@ -185,3 +185,33 @@ def test_config_required_bool_enforced():
     assert parse_config(Conf, ["--flag"]).flag is True
     with _pytest.raises(SystemExit):
         parse_config(Conf, [])
+
+
+def test_fit_fused_matches_eager_label_estimator():
+    from keystone_tpu.ops.linear import BlockLeastSquaresEstimator
+
+    rng = np.random.default_rng(0)
+    data = jnp.asarray(rng.normal(size=(64, 12)).astype(np.float32))
+    labels = jnp.asarray(rng.normal(size=(64, 3)).astype(np.float32))
+    chained = Scale(factor=jnp.float32(1.5)) >> BlockLeastSquaresEstimator(
+        block_size=8, num_iter=2, lam=1e-2
+    )
+    eager = chained.fit(data, labels, n_valid=60)
+    fused = chained.fit_fused(data, labels, n_valid=60)
+    np.testing.assert_allclose(
+        np.asarray(eager(data)), np.asarray(fused(data)), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_fit_fused_matches_eager_estimator():
+    from keystone_tpu.ops.linalg import PCAEstimator
+
+    rng = np.random.default_rng(1)
+    data = jnp.asarray(rng.normal(size=(40, 6)).astype(np.float32))
+    chained = Scale(factor=jnp.float32(2.0)) >> PCAEstimator(dims=3)
+    eager = chained.fit(data)
+    fused = chained.fit_fused(data)
+    # PCA columns are sign-fixed, outputs should agree exactly up to fp
+    np.testing.assert_allclose(
+        np.asarray(eager(data)), np.asarray(fused(data)), rtol=1e-4, atol=1e-4
+    )
