@@ -23,6 +23,7 @@ scalar index + host transfer.
 
 from __future__ import annotations
 
+import dataclasses
 import datetime
 import json
 import os
@@ -180,9 +181,12 @@ def bench_mnist(labels: np.ndarray, data: np.ndarray) -> dict:
     chained = ChainedLabelEstimator(prefix=bank, est=est)
 
     # featurize + fit as ONE traced program (fit_fused): a fit step pays a
-    # single device launch instead of one per stage
+    # single device launch instead of one per stage. Return the fitted
+    # MODEL node ([-1]) — the pipeline's first leaves are the prefix
+    # bank's constants, and _sync on one of those would return before the
+    # fit program has executed
     def step():
-        return chained.fit_fused(x, y, n_valid=n)
+        return chained.fit_fused(x, y, n_valid=n)[-1]
 
     sec = _timed(step)
     d = NUM_FFTS * 512  # total feature width
@@ -325,6 +329,62 @@ def bench_cpu_weighted() -> float:
     t_solve = (time.perf_counter() - t0) * (c / c_sub)
     # two BCD passes of solves (Grams are cached pass-invariant)
     return n / (t_gram + 2 * t_solve)
+
+
+LM_DIM, LM_DEPTH, LM_HEADS = 1024, 8, 16
+LM_SEQ, LM_BATCH, LM_VOCAB = 2048, 8, 32_768
+
+
+def bench_lm_train() -> dict:
+    """One sharded LM train step (models/lm_transformer.py): the
+    training-side MFU workload — forward+backward+AdamW as a single
+    buffer-donated program. TPU-only (skipped on the CPU fallback: a
+    ~17 TFLOP step is minutes of host time)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from keystone_tpu.models import lm_transformer as lm
+    from keystone_tpu.parallel.mesh import create_mesh
+
+    mesh = create_mesh() if len(jax.devices()) > 1 else None
+    model = lm.TransformerLM.create(
+        jax.random.key(0),
+        vocab=LM_VOCAB,
+        max_seq=LM_SEQ,
+        dim=LM_DIM,
+        depth=LM_DEPTH,
+        num_heads=LM_HEADS,
+    )
+    model = dataclasses.replace(model, remat=True)
+    model = lm.shard_params(model, mesh)
+    optimizer = optax.adamw(3e-4, weight_decay=0.01)
+    opt_state = optimizer.init(model)
+    step = lm.make_train_step(optimizer)
+    toks = jnp.asarray(
+        np.random.default_rng(0).integers(
+            0, LM_VOCAB, size=(LM_BATCH, LM_SEQ + 1), dtype=np.int32
+        )
+    )
+    if mesh is not None and LM_BATCH % mesh.shape.get("data", 1) == 0:
+        from keystone_tpu.parallel.mesh import data_sharding
+
+        # dp-shard the batch so the per-chip TFLOP divide below is honest
+        toks = jax.device_put(toks, data_sharding(mesh, ndim=2))
+    flops = lm.train_step_flops(model, LM_BATCH, LM_SEQ)
+    state = [model, opt_state]
+
+    def stepper():
+        m2, o2, loss = step(state[0], state[1], toks)
+        state[0], state[1] = m2, o2
+        return loss
+
+    sec = _timed(stepper, iters=3)
+    return {
+        "tokens_per_s": LM_BATCH * LM_SEQ / sec,
+        "tflops_per_s": flops / sec / 1e12 / len(jax.devices()),
+        "params": model.num_params(),
+    }
 
 
 def bench_sift() -> dict:
@@ -502,6 +562,7 @@ def main() -> None:
         cifar = bench_cifar_conv()
         weighted = bench_weighted()
         sift = bench_sift()
+        lm = None if fallback else bench_lm_train()
     except Exception as e:  # noqa: BLE001 — tunnel died mid-run
         if fallback:
             raise
@@ -581,6 +642,13 @@ def main() -> None:
     }
     if "vs_native_host" in sift:
         result["sift_vs_native_host"] = round(sift["vs_native_host"], 2)
+    if lm is not None:
+        result["lm_train_tokens_per_s"] = round(lm["tokens_per_s"], 1)
+        result["lm_train_tflops_per_chip"] = round(lm["tflops_per_s"], 2)
+        if peak is not None:
+            result["lm_train_mfu_vs_bf16_peak"] = round(
+                lm["tflops_per_s"] * 1e12 / peak, 4
+            )
     if peak is not None and not fallback:
         # "est": featurize FLOPs are an analytic estimate (cosine gemm
         # term only) — measured time, modeled FLOPs (ADVICE r2 #4). The

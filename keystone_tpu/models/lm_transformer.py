@@ -1,0 +1,391 @@
+"""Decoder-only transformer LM with a fully sharded training step.
+
+The reference has no sequence models at all (SURVEY §5: long-context
+"absent"), but long-context + distributed are first-class capabilities of
+this framework, not parity afterthoughts. This model is the training-side
+consumer of that stack:
+
+- causal attention via :mod:`keystone_tpu.ops.attention` — dense, fused
+  Pallas flash, or sequence-parallel ring / Ulysses (`seq_mode`), so one
+  flag takes the same model from a single chip to a sequence-sharded mesh
+  for contexts that don't fit one device;
+- tensor parallelism by sharding each weight over the mesh ``model`` axis
+  (head-parallel attention, column/row-parallel MLP, vocab-parallel tied
+  embedding) — XLA inserts the psums, the model code stays purely
+  functional;
+- data parallelism over the ``data`` axis;
+- one jitted, buffer-donated train step (AdamW via optax) — the whole
+  update is a single XLA program, the idiom the rest of the framework uses
+  for its solvers (one launch per step, no host round-trips).
+
+This is a beyond-reference capability in the same spirit as
+``models/vit_ridge.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.core.config import arg, parse_config
+from keystone_tpu.core.logging import get_logger
+from keystone_tpu.core.treenode import static_field, treenode
+from keystone_tpu.ops.attention import (
+    dense_attention,
+    ring_attention,
+    ulysses_attention,
+)
+from keystone_tpu.ops.vit import _layer_norm
+
+logger = get_logger("models.lm_transformer")
+
+
+@treenode
+class LMBlock:
+    wq: jnp.ndarray  # (d, d)
+    wk: jnp.ndarray
+    wv: jnp.ndarray
+    wo: jnp.ndarray
+    w1: jnp.ndarray  # (d, ff)
+    w2: jnp.ndarray  # (ff, d)
+
+
+@treenode
+class TransformerLM:
+    """Pre-LN decoder-only LM; logits tied to the token embedding."""
+
+    embed: jnp.ndarray  # (V, d)
+    pos_embed: jnp.ndarray  # (S_max, d)
+    blocks: tuple  # of LMBlock
+    num_heads: int = static_field(default=8)
+    # attention strategy: "local" (dense or Pallas flash on TPU),
+    # "ring" / "ulysses" (sequence-parallel over `seq_axis` of `mesh`)
+    seq_mode: str = static_field(default="local")
+    mesh: object = static_field(default=None)
+    seq_axis: str = static_field(default="data")
+    # rematerialize each block in the backward pass: activation memory
+    # drops from O(depth · S · d) per-layer intermediates to the block
+    # boundaries only — the jax.checkpoint successor of the reference's
+    # nothing (it never trained deep models)
+    remat: bool = static_field(default=False)
+
+    def _attention(self, x, blk: LMBlock):
+        n, s, d = x.shape
+        h = self.num_heads
+        hd = d // h
+
+        def split(w):
+            return (x @ w).reshape(n, s, h, hd).transpose(0, 2, 1, 3)
+
+        q, k, v = split(blk.wq), split(blk.wk), split(blk.wv)
+        # the sequence-parallel paths pin use_flash=False: the per-hop
+        # Pallas kernels are forward-only, and training differentiates
+        # through the ring/all-to-all — the jnp blockwise update is
+        # differentiable end-to-end (ppermute/all_to_all have transposes)
+        if self.seq_mode == "ring":
+            out = ring_attention(
+                q, k, v, self.mesh, seq_axis=self.seq_axis, causal=True,
+                use_flash=False,
+            )
+        elif self.seq_mode == "ulysses":
+            out = ulysses_attention(
+                q, k, v, self.mesh, seq_axis=self.seq_axis, causal=True,
+                use_flash=False,
+            )
+        else:
+            from keystone_tpu.ops.flash_attention import on_tpu
+
+            if on_tpu():
+                # fused Pallas forward with a recompute VJP — training
+                # never materializes the (S, S) probabilities
+                from keystone_tpu.ops.flash_attention import (
+                    flash_attention_trainable,
+                )
+
+                out = flash_attention_trainable(q, k, v, True)
+            else:
+                out = dense_attention(q, k, v, causal=True)
+        return out.transpose(0, 2, 1, 3).reshape(n, s, d) @ blk.wo
+
+    def __call__(self, tokens):
+        """(B, S) int tokens → (B, S, V) logits."""
+        d = self.embed.shape[-1]
+        x = self.embed[tokens] * math.sqrt(d)
+        x = x + self.pos_embed[: tokens.shape[1]]
+
+        def block_fn(x, blk):
+            x = x + self._attention(_layer_norm(x), blk)
+            hdn = _layer_norm(x) @ blk.w1
+            return x + jax.nn.gelu(hdn) @ blk.w2
+
+        if self.remat:
+            block_fn = jax.checkpoint(block_fn)
+        for blk in self.blocks:
+            x = block_fn(x, blk)
+        return _layer_norm(x) @ self.embed.T
+
+    @staticmethod
+    def create(
+        key,
+        vocab: int = 256,
+        max_seq: int = 512,
+        dim: int = 256,
+        depth: int = 4,
+        num_heads: int = 8,
+        ff_mult: int = 4,
+        seq_mode: str = "local",
+        mesh=None,
+        seq_axis: str = "data",
+    ) -> "TransformerLM":
+        keys = jax.random.split(key, 2 + 6 * depth)
+
+        def init(k, shape, fan_in):
+            return jax.random.normal(k, shape, jnp.float32) / math.sqrt(fan_in)
+
+        blocks = []
+        for i in range(depth):
+            ks = keys[2 + 6 * i : 8 + 6 * i]
+            blocks.append(
+                LMBlock(
+                    wq=init(ks[0], (dim, dim), dim),
+                    wk=init(ks[1], (dim, dim), dim),
+                    wv=init(ks[2], (dim, dim), dim),
+                    wo=init(ks[3], (dim, dim), dim),
+                    w1=init(ks[4], (dim, ff_mult * dim), dim),
+                    w2=init(ks[5], (ff_mult * dim, dim), ff_mult * dim),
+                )
+            )
+        return TransformerLM(
+            embed=0.02 * jax.random.normal(keys[0], (vocab, dim)),
+            pos_embed=0.02 * jax.random.normal(keys[1], (max_seq, dim)),
+            blocks=tuple(blocks),
+            num_heads=num_heads,
+            seq_mode=seq_mode,
+            mesh=mesh,
+            seq_axis=seq_axis,
+        )
+
+    def num_params(self) -> int:
+        return sum(
+            int(np.prod(leaf.shape)) for leaf in jax.tree_util.tree_leaves(self)
+        )
+
+
+def shard_params(model: TransformerLM, mesh) -> TransformerLM:
+    """Lay the weights out for tensor parallelism over the mesh ``model``
+    axis: attention q/k/v column-sharded (head-parallel) with wo
+    row-sharded, MLP column- then row-sharded, embedding vocab-sharded.
+    XLA then inserts exactly the two psums per block that hand-written
+    Megatron-style TP would — the layout IS the parallelism.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if mesh is None or mesh.shape.get("model", 1) == 1:
+        return model
+    n_model = mesh.shape["model"]
+
+    def put(x, spec):
+        # a dim not divisible by the axis (e.g. an unpadded vocab) is
+        # replicated rather than rejected
+        spec = P(
+            *(
+                a
+                if a is None or x.shape[i] % n_model == 0
+                else None
+                for i, a in enumerate(spec)
+            )
+        )
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    blocks = tuple(
+        LMBlock(
+            wq=put(b.wq, P(None, "model")),
+            wk=put(b.wk, P(None, "model")),
+            wv=put(b.wv, P(None, "model")),
+            wo=put(b.wo, P("model", None)),
+            w1=put(b.w1, P(None, "model")),
+            w2=put(b.w2, P("model", None)),
+        )
+        for b in model.blocks
+    )
+    return dataclasses.replace(
+        model,
+        embed=put(model.embed, P("model", None)),
+        pos_embed=put(model.pos_embed, P()),
+        blocks=blocks,
+    )
+
+
+def next_token_loss(model: TransformerLM, tokens) -> jnp.ndarray:
+    """Mean cross-entropy of predicting ``tokens[:, 1:]`` from the prefix
+    (the model runs on the first S tokens of an S+1 window)."""
+    logits = model(tokens[:, :-1])
+    targets = tokens[:, 1:]
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)
+
+
+def make_train_step(optimizer):
+    """One buffer-donated jitted program: grads + AdamW update + loss."""
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(model, opt_state, tokens):
+        loss, grads = jax.value_and_grad(next_token_loss)(model, tokens)
+        updates, opt_state = optimizer.update(
+            grads, opt_state, params=model
+        )
+        import optax
+
+        model = optax.apply_updates(model, updates)
+        return model, opt_state, loss
+
+    return step
+
+
+def train(
+    model: TransformerLM,
+    corpus: np.ndarray,
+    *,
+    steps: int,
+    batch: int,
+    seq: int,
+    lr: float = 3e-4,
+    mesh=None,
+    seed: int = 0,
+    log_every: int = 0,
+):
+    """Train on random windows of ``corpus`` (1-D int array). Returns
+    (model, losses). Batches are dp-sharded over the mesh ``data`` axis
+    unless the model is sequence-parallel (then S is the sharded axis and
+    the batch is replicated)."""
+    import optax
+
+    from keystone_tpu.parallel.mesh import data_sharding
+
+    optimizer = optax.adamw(lr, weight_decay=0.01)
+    opt_state = optimizer.init(model)
+    step = make_train_step(optimizer)
+    rng = np.random.default_rng(seed)
+    losses = []
+    sharding = None
+    if (
+        mesh is not None
+        and model.seq_mode == "local"
+        and batch % mesh.shape.get("data", 1) == 0
+    ):
+        sharding = data_sharding(mesh, ndim=2)
+    for i in range(steps):
+        starts = rng.integers(0, len(corpus) - seq - 1, size=batch)
+        toks = np.stack([corpus[s : s + seq + 1] for s in starts])
+        toks = jnp.asarray(toks)
+        if sharding is not None:
+            toks = jax.device_put(toks, sharding)
+        model, opt_state, loss = step(model, opt_state, toks)
+        losses.append(float(loss))
+        if log_every and (i + 1) % log_every == 0:
+            logger.info("step %d loss %.4f", i + 1, losses[-1])
+    return model, losses
+
+
+def train_step_flops(model: TransformerLM, batch: int, seq: int) -> float:
+    """Analytic FLOPs of one train step: ~6·P·tokens for the matmul work
+    plus the attention score/value terms (12·L·d·S²·B fwd+bwd)."""
+    p = model.num_params()
+    tokens = batch * seq
+    d = model.embed.shape[-1]
+    attn = 12 * len(model.blocks) * d * seq * seq * batch
+    return 6.0 * p * tokens + attn
+
+
+def synthetic_corpus(n: int, vocab: int, seed: int = 0) -> np.ndarray:
+    """A learnable-but-not-trivial token stream: an order-1 Markov chain
+    with a sparse, deterministic-ish transition structure."""
+    rng = np.random.default_rng(seed)
+    succ = rng.integers(0, vocab, size=(vocab, 4))
+    probs = np.array([0.7, 0.15, 0.1, 0.05])
+    out = np.empty(n, np.int32)
+    out[0] = 0
+    choices = rng.choice(4, size=n, p=probs)
+    for i in range(1, n):
+        out[i] = succ[out[i - 1], choices[i]]
+    return out
+
+
+@dataclasses.dataclass
+class LMConfig:
+    steps: int = arg(default=60, help="training steps")
+    batch: int = arg(default=8)
+    seq: int = arg(default=256)
+    dim: int = arg(default=256)
+    depth: int = arg(default=4)
+    num_heads: int = arg(default=8)
+    vocab: int = arg(default=256)
+    lr: float = arg(default=3e-4)
+    seq_mode: str = arg(
+        default="local", help="attention strategy: local | ring | ulysses"
+    )
+    seed: int = arg(default=0)
+
+
+def run(conf: LMConfig, mesh=None) -> dict:
+    from keystone_tpu.parallel.mesh import create_mesh
+
+    if mesh is None and len(jax.devices()) > 1:
+        mesh = create_mesh()
+    key = jax.random.key(conf.seed)
+    model = TransformerLM.create(
+        key,
+        vocab=conf.vocab,
+        max_seq=conf.seq,
+        dim=conf.dim,
+        depth=conf.depth,
+        num_heads=conf.num_heads,
+        seq_mode=conf.seq_mode,
+        mesh=mesh if conf.seq_mode != "local" else None,
+    )
+    model = shard_params(model, mesh)
+    corpus = synthetic_corpus(200_000, conf.vocab, seed=conf.seed)
+    t0 = time.time()
+    model, losses = train(
+        model,
+        corpus,
+        steps=conf.steps,
+        batch=conf.batch,
+        seq=conf.seq,
+        lr=conf.lr,
+        mesh=mesh,
+        seed=conf.seed,
+        log_every=max(conf.steps // 5, 1),
+    )
+    dt = time.time() - t0
+    res = {
+        "loss_first": losses[0],
+        "loss_last": float(np.mean(losses[-5:])),
+        "steps": conf.steps,
+        "params": model.num_params(),
+        "tokens_per_s": conf.steps * conf.batch * conf.seq / dt,
+        "wall_s": dt,
+    }
+    logger.info(
+        "lm: %d params, loss %.3f -> %.3f, %.0f tokens/s",
+        res["params"],
+        res["loss_first"],
+        res["loss_last"],
+        res["tokens_per_s"],
+    )
+    return res
+
+
+def main(argv=None) -> dict:
+    return run(parse_config(LMConfig, argv))
+
+
+if __name__ == "__main__":
+    main()

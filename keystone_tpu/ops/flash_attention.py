@@ -528,3 +528,39 @@ def flash_attention_step(
         l2[:, :s_q, 0].reshape(b, h, s_q),
         acc2[:, :s_q, :d].reshape(b, h, s_q, d),
     )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def flash_attention_trainable(q, k, v, causal: bool = False):
+    """Differentiable fused attention: Pallas flash forward, recompute
+    backward.
+
+    The flash kernels above are forward-only (inference featurizers and
+    the ring/Ulysses per-hop updates). Training needs a VJP: save ONLY
+    (q, k, v) from the forward — nothing S²-sized persists between the
+    forward and backward (with per-layer remat that's what bounds memory
+    ACROSS the step) — and recompute the attention inside the backward by
+    differentiating the dense formulation. The backward itself does
+    materialize O(B·H·S²) score/probability tensors transiently, so its
+    peak lives at the single layer being differentiated; at the long
+    contexts where even one such tensor cannot fit, use the
+    sequence-parallel paths (ring/Ulysses shard S before the S² term
+    forms). A blockwise-scan backward kernel would remove the transient
+    — current status: forward fused, backward dense-recompute.
+    """
+    return flash_attention(q, k, v, causal=causal)
+
+
+def _flash_trainable_fwd(q, k, v, causal: bool):
+    return flash_attention(q, k, v, causal=causal), (q, k, v)
+
+
+def _flash_trainable_bwd(causal: bool, res, g):
+    from keystone_tpu.ops.attention import dense_attention
+
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: dense_attention(q, k, v, causal=causal), q, k, v)
+    return vjp(g)
+
+
+flash_attention_trainable.defvjp(_flash_trainable_fwd, _flash_trainable_bwd)

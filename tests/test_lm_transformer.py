@@ -1,0 +1,94 @@
+"""Transformer LM training: loss decreases, sharded-step parity, and the
+sequence-parallel attention modes plug into the same model."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu.models import lm_transformer as lm
+
+
+def _tiny(seq_mode="local", mesh=None, dim=64, depth=2, vocab=31, heads=4):
+    return lm.TransformerLM.create(
+        jax.random.key(0),
+        vocab=vocab,
+        max_seq=64,
+        dim=dim,
+        depth=depth,
+        num_heads=heads,
+        seq_mode=seq_mode,
+        mesh=mesh,
+    )
+
+
+def test_loss_decreases_on_markov_corpus():
+    model = _tiny()
+    corpus = lm.synthetic_corpus(20_000, 31, seed=1)
+    model, losses = lm.train(
+        model, corpus, steps=60, batch=8, seq=32, lr=2e-3, seed=1
+    )
+    assert np.mean(losses[-5:]) < 0.6 * losses[0], (losses[0], losses[-5:])
+
+
+def test_forward_shapes_and_causality():
+    model = _tiny()
+    toks = jnp.asarray(
+        np.random.default_rng(0).integers(0, 31, size=(2, 24))
+    )
+    logits = model(toks)
+    assert logits.shape == (2, 24, 31)
+    # causality: changing a future token must not change past logits
+    toks2 = toks.at[:, 20].set((toks[:, 20] + 1) % 31)
+    logits2 = model(toks2)
+    np.testing.assert_allclose(
+        np.asarray(logits[:, :20]), np.asarray(logits2[:, :20]), atol=1e-5
+    )
+
+
+def test_tp_sharded_step_matches_single_device(mesh4x2):
+    """dp×tp sharded training step computes the same update as unsharded.
+
+    The train step donates its input buffers and device_put may alias the
+    source buffer for same-device shards, so the two runs each build their
+    own (same-seed, identical) model."""
+    model = _tiny(dim=64, depth=2)
+    sharded = lm.shard_params(_tiny(dim=64, depth=2), mesh4x2)
+    corpus = lm.synthetic_corpus(5_000, 31, seed=2)
+    m1, l1 = lm.train(model, corpus, steps=3, batch=8, seq=32, seed=3)
+    m2, l2 = lm.train(
+        sharded, corpus, steps=3, batch=8, seq=32, seed=3, mesh=mesh4x2
+    )
+    np.testing.assert_allclose(l1, l2, rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(
+        np.asarray(m1.blocks[0].wq),
+        np.asarray(m2.blocks[0].wq),
+        rtol=2e-4,
+        atol=2e-4,
+    )
+
+
+@pytest.mark.parametrize("seq_mode", ["ring", "ulysses"])
+def test_sequence_parallel_forward_parity(mesh8, seq_mode):
+    """ring/Ulysses causal attention inside the LM matches local attention."""
+    # Ulysses reshards heads over the axis: needs heads % axis == 0
+    local = _tiny(dim=64, depth=2, heads=8)
+    sp = dataclasses.replace(local, seq_mode=seq_mode, mesh=mesh8)
+    toks = jnp.asarray(
+        np.random.default_rng(4).integers(0, 31, size=(2, 64))
+    )
+    np.testing.assert_allclose(
+        np.asarray(local(toks)), np.asarray(sp(toks)), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_cli_main_tiny():
+    res = lm.main(
+        [
+            "--steps", "4", "--batch", "2", "--seq", "32", "--dim", "32",
+            "--depth", "1", "--num-heads", "2", "--vocab", "17",
+        ]
+    )
+    assert res["params"] > 0 and np.isfinite(res["loss_last"])
