@@ -366,11 +366,14 @@ def bench_lm_train() -> dict:
             0, LM_VOCAB, size=(LM_BATCH, LM_SEQ + 1), dtype=np.int32
         )
     )
+    n_chips = 1
     if mesh is not None and LM_BATCH % mesh.shape.get("data", 1) == 0:
         from keystone_tpu.parallel.mesh import data_sharding
 
-        # dp-shard the batch so the per-chip TFLOP divide below is honest
+        # dp-shard the batch; only then is a per-chip divide honest
+        # (unsharded, every chip would replicate the full step)
         toks = jax.device_put(toks, data_sharding(mesh, ndim=2))
+        n_chips = len(jax.devices())
     flops = lm.train_step_flops(model, LM_BATCH, LM_SEQ)
     state = [model, opt_state]
 
@@ -382,7 +385,7 @@ def bench_lm_train() -> dict:
     sec = _timed(stepper, iters=3)
     return {
         "tokens_per_s": LM_BATCH * LM_SEQ / sec,
-        "tflops_per_s": flops / sec / 1e12 / len(jax.devices()),
+        "tflops_per_s": flops / sec / 1e12 / n_chips,
         "params": model.num_params(),
     }
 

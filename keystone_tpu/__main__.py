@@ -7,6 +7,7 @@ reference-style class name, so existing KeystoneML invocations map 1:1.
 
 from __future__ import annotations
 
+import os
 import sys
 
 # short name → (module, reference class name)
@@ -48,10 +49,24 @@ PIPELINES = {
         "pipelines.nlp.StupidBackoffPipeline",
     ),
     "vit-ridge": ("keystone_tpu.models.vit_ridge", None),
+    "lm-transformer": ("keystone_tpu.models.lm_transformer", None),
 }
 
 
 def main(argv: list[str] | None = None) -> None:
+    # honor a JAX_PLATFORMS env pin even when a sitecustomize pre-imported
+    # jax with another platform baked into the config (same workaround as
+    # tests/conftest.py): backend init is lazy, so re-asserting before
+    # first device use wins. Without this, `JAX_PLATFORMS=cpu python -m
+    # keystone_tpu ...` on a host whose accelerator tunnel is down hangs
+    # at backend init instead of running on the CPU.
+    plat = os.environ.get("JAX_PLATFORMS", "")
+    if plat:
+        import jax
+
+        # full string, not the first entry: "tpu,cpu" keeps its
+        # fall-back-to-cpu semantics
+        jax.config.update("jax_platforms", plat)
     argv = list(sys.argv[1:] if argv is None else argv)
     multihost = "--multihost" in argv
     if multihost:

@@ -43,7 +43,7 @@ from keystone_tpu.ops.attention import (
 )
 from keystone_tpu.ops.vit import _layer_norm
 
-logger = get_logger("models.lm_transformer")
+logger = get_logger("keystone_tpu.models.lm_transformer")
 
 
 @treenode
@@ -288,10 +288,12 @@ def train(
         if sharding is not None:
             toks = jax.device_put(toks, sharding)
         model, opt_state, loss = step(model, opt_state, toks)
-        losses.append(float(loss))
+        # keep the loss on device: a float() here would block a host
+        # round-trip into every step and serialize the dispatch queue
+        losses.append(loss)
         if log_every and (i + 1) % log_every == 0:
-            logger.info("step %d loss %.4f", i + 1, losses[-1])
-    return model, losses
+            logger.info("step %d loss %.4f", i + 1, float(loss))
+    return model, [float(l) for l in losses]
 
 
 def train_step_flops(model: TransformerLM, batch: int, seq: int) -> float:
