@@ -355,6 +355,7 @@ def bench_lm_train() -> dict:
         dim=LM_DIM,
         depth=LM_DEPTH,
         num_heads=LM_HEADS,
+        compute_dtype="bfloat16",
     )
     model = dataclasses.replace(model, remat=True)
     model = lm.shard_params(model, mesh)
@@ -387,6 +388,48 @@ def bench_lm_train() -> dict:
         "tokens_per_s": LM_BATCH * LM_SEQ / sec,
         "tflops_per_s": flops / sec / 1e12 / n_chips,
         "params": model.num_params(),
+    }
+
+
+def bench_lm_decode() -> dict:
+    """Autoregressive generation throughput: prefill + lax.scan KV-cache
+    decode as ONE jitted program (models/lm_transformer.py generate).
+    Decode is the HBM-bound regime — every step re-reads all params — so
+    tokens/s, not MFU, is the honest metric. TPU-only like bench_lm_train."""
+    import jax
+    import jax.numpy as jnp
+
+    from keystone_tpu.models import lm_transformer as lm
+
+    model = lm.TransformerLM.create(
+        jax.random.key(0),
+        vocab=LM_VOCAB,
+        max_seq=LM_SEQ,
+        dim=LM_DIM,
+        depth=LM_DEPTH,
+        num_heads=LM_HEADS,
+        compute_dtype="bfloat16",
+    )
+    prompt = jnp.asarray(
+        np.random.default_rng(1).integers(
+            0, LM_VOCAB, size=(LM_BATCH, 128), dtype=np.int32
+        )
+    )
+    max_new = 256
+    # max_new=1 is prefill + one pick (zero decode steps); the delta to
+    # max_new=256 is 255 pure decode steps — keeps prefill time out of
+    # the decode rate
+    sec_prefill = _timed(
+        lambda: lm.generate(model, prompt, max_new=1), iters=3
+    )
+    sec_full = _timed(
+        lambda: lm.generate(model, prompt, max_new=max_new), iters=3
+    )
+    step_s = max(sec_full - sec_prefill, 1e-9) / (max_new - 1)
+    return {
+        "decode_tokens_per_s": LM_BATCH / step_s,
+        "ms_per_step": step_s * 1e3,
+        "prefill_ms": sec_prefill * 1e3,
     }
 
 
@@ -538,6 +581,7 @@ def _device_peak() -> float | None:
 def main() -> None:
     global N_TRAIN, CIFAR_N, TIMIT_N, TIMIT_D, SIFT_N
 
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     # a cpu-pinned environment (e.g. the mid-run-failure rerun child)
     # cannot have an accelerator: skip the multi-attempt probe entirely
     cpu_pinned = os.environ.get("JAX_PLATFORMS", "").split(",")[0] == "cpu"
@@ -546,16 +590,14 @@ def main() -> None:
         # run the same jax program on the host CPU and say so — an honest
         # degraded measurement beats a hung driver. Scale the workloads
         # down (rates stay per-sample) so the fallback finishes promptly.
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        import jax
+        from keystone_tpu.core.runtime import pin_platform
 
-        jax.config.update("jax_platforms", "cpu")
+        pin_platform("cpu")
         N_TRAIN = 12_000
         CIFAR_N = 512
         TIMIT_N = 8_192
         TIMIT_D = 512
         SIFT_N = 4
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from keystone_tpu.core.runtime import enable_compilation_cache
 
     enable_compilation_cache()
@@ -566,6 +608,7 @@ def main() -> None:
         weighted = bench_weighted()
         sift = bench_sift()
         lm = None if fallback else bench_lm_train()
+        lm_dec = None if fallback else bench_lm_decode()
     except Exception as e:  # noqa: BLE001 — tunnel died mid-run
         if fallback:
             raise
@@ -652,6 +695,10 @@ def main() -> None:
             result["lm_train_mfu_vs_bf16_peak"] = round(
                 lm["tflops_per_s"] * 1e12 / peak, 4
             )
+    if lm_dec is not None:
+        result["lm_decode_tokens_per_s"] = round(
+            lm_dec["decode_tokens_per_s"], 1
+        )
     if peak is not None and not fallback:
         # "est": featurize FLOPs are an analytic estimate (cosine gemm
         # term only) — measured time, modeled FLOPs (ADVICE r2 #4). The

@@ -54,19 +54,12 @@ PIPELINES = {
 
 
 def main(argv: list[str] | None = None) -> None:
-    # honor a JAX_PLATFORMS env pin even when a sitecustomize pre-imported
-    # jax with another platform baked into the config (same workaround as
-    # tests/conftest.py): backend init is lazy, so re-asserting before
-    # first device use wins. Without this, `JAX_PLATFORMS=cpu python -m
-    # keystone_tpu ...` on a host whose accelerator tunnel is down hangs
-    # at backend init instead of running on the CPU.
-    plat = os.environ.get("JAX_PLATFORMS", "")
-    if plat:
-        import jax
+    # honor a JAX_PLATFORMS env pin — without this, `JAX_PLATFORMS=cpu
+    # python -m keystone_tpu ...` on a host whose accelerator tunnel is
+    # down hangs at backend init instead of running on the CPU
+    from keystone_tpu.core.runtime import pin_platform
 
-        # full string, not the first entry: "tpu,cpu" keeps its
-        # fall-back-to-cpu semantics
-        jax.config.update("jax_platforms", plat)
+    pin_platform()
     argv = list(sys.argv[1:] if argv is None else argv)
     multihost = "--multihost" in argv
     if multihost:

@@ -17,6 +17,30 @@ _DEFAULT_CACHE_DIR = os.path.join(
 )
 
 
+def pin_platform(platform: str | None = None) -> str | None:
+    """Re-assert a platform choice against a sitecustomize that
+    pre-imported jax with another platform baked into its config.
+
+    Backend init is lazy, so updating ``jax_platforms`` before first
+    device use wins even post-import. ``platform=None`` honors an
+    existing ``JAX_PLATFORMS`` env pin; an explicit value (e.g. "cpu")
+    also exports the env var so child processes inherit it. The full
+    string is kept, not the first entry: "tpu,cpu" retains its
+    fall-back-to-cpu semantics. Returns the pinned string (or None when
+    no pin was requested). The one workaround lives here — conftest,
+    bench, and the launcher all call this.
+    """
+    if platform is not None:
+        os.environ["JAX_PLATFORMS"] = platform
+    plat = os.environ.get("JAX_PLATFORMS", "")
+    if not plat:
+        return None
+    import jax
+
+    jax.config.update("jax_platforms", plat)
+    return plat
+
+
 def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
     """Point JAX's persistent compilation cache at a durable directory.
 

@@ -56,6 +56,36 @@ class LMBlock:
     w2: jnp.ndarray  # (ff, d)
 
 
+def _ln(x, cdt):
+    # normalization stats in f32 even under a bf16 policy: the
+    # mean/variance cancellation is exactly what bf16 loses
+    return _layer_norm(x.astype(jnp.float32)).astype(cdt)
+
+
+def _split_heads(y, w, h):
+    n, s, d = y.shape
+    return (
+        (y @ w.astype(y.dtype)).reshape(n, s, h, d // h).transpose(0, 2, 1, 3)
+    )
+
+
+def _block_apply(x, blk: LMBlock, cdt, attn):
+    """Pre-LN residual block shared by training forward, prefill, and
+    decode: ``attn(y, blk) -> (attention output (N,S,d), aux)``."""
+    a, aux = attn(_ln(x, cdt), blk)
+    x = x + a
+    hdn = _ln(x, cdt) @ blk.w1.astype(cdt)
+    return x + jax.nn.gelu(hdn) @ blk.w2.astype(cdt), aux
+
+
+def _tied_logits(x, embed, cdt):
+    # bf16 operands, f32 accumulate/output: the logits feed a logsumexp —
+    # bf16 logits would cost real perplexity precision
+    return jnp.matmul(
+        _ln(x, cdt), embed.T.astype(cdt), preferred_element_type=jnp.float32
+    )
+
+
 @treenode
 class TransformerLM:
     """Pre-LN decoder-only LM; logits tied to the token embedding."""
@@ -74,16 +104,19 @@ class TransformerLM:
     # boundaries only — the jax.checkpoint successor of the reference's
     # nothing (it never trained deep models)
     remat: bool = static_field(default=False)
+    # mixed precision: params/optimizer state stay float32; activations
+    # and the matmul operands run in this dtype ("bfloat16" halves HBM
+    # traffic and feeds the MXU its native input width). LayerNorm stats
+    # and the loss reduction stay float32 regardless.
+    compute_dtype: str = static_field(default="float32")
 
-    def _attention(self, x, blk: LMBlock):
+    def _attention(self, x, blk: LMBlock, return_kv: bool = False):
         n, s, d = x.shape
         h = self.num_heads
-        hd = d // h
 
-        def split(w):
-            return (x @ w).reshape(n, s, h, hd).transpose(0, 2, 1, 3)
-
-        q, k, v = split(blk.wq), split(blk.wk), split(blk.wv)
+        q, k, v = (
+            _split_heads(x, w, h) for w in (blk.wq, blk.wk, blk.wv)
+        )
         # the sequence-parallel paths pin use_flash=False: the per-hop
         # Pallas kernels are forward-only, and training differentiates
         # through the ring/all-to-all — the jnp blockwise update is
@@ -111,24 +144,30 @@ class TransformerLM:
                 out = flash_attention_trainable(q, k, v, True)
             else:
                 out = dense_attention(q, k, v, causal=True)
-        return out.transpose(0, 2, 1, 3).reshape(n, s, d) @ blk.wo
+        proj = out.transpose(0, 2, 1, 3).reshape(n, s, d).astype(
+            x.dtype
+        ) @ blk.wo.astype(x.dtype)
+        if return_kv:
+            return proj, (k, v)
+        return proj
 
     def __call__(self, tokens):
-        """(B, S) int tokens → (B, S, V) logits."""
+        """(B, S) int tokens → (B, S, V) float32 logits."""
+        cdt = jnp.dtype(self.compute_dtype)
         d = self.embed.shape[-1]
         x = self.embed[tokens] * math.sqrt(d)
-        x = x + self.pos_embed[: tokens.shape[1]]
+        x = (x + self.pos_embed[: tokens.shape[1]]).astype(cdt)
 
         def block_fn(x, blk):
-            x = x + self._attention(_layer_norm(x), blk)
-            hdn = _layer_norm(x) @ blk.w1
-            return x + jax.nn.gelu(hdn) @ blk.w2
+            return _block_apply(
+                x, blk, cdt, lambda y, b: (self._attention(y, b), None)
+            )[0]
 
         if self.remat:
             block_fn = jax.checkpoint(block_fn)
         for blk in self.blocks:
             x = block_fn(x, blk)
-        return _layer_norm(x) @ self.embed.T
+        return _tied_logits(x, self.embed, cdt)
 
     @staticmethod
     def create(
@@ -142,6 +181,7 @@ class TransformerLM:
         seq_mode: str = "local",
         mesh=None,
         seq_axis: str = "data",
+        compute_dtype: str = "float32",
     ) -> "TransformerLM":
         keys = jax.random.split(key, 2 + 6 * depth)
 
@@ -169,6 +209,7 @@ class TransformerLM:
             seq_mode=seq_mode,
             mesh=mesh,
             seq_axis=seq_axis,
+            compute_dtype=compute_dtype,
         )
 
     def num_params(self) -> int:
@@ -220,6 +261,154 @@ def shard_params(model: TransformerLM, mesh) -> TransformerLM:
         pos_embed=put(model.pos_embed, P()),
         blocks=blocks,
     )
+
+
+@treenode
+class KVCache:
+    """Preallocated decode cache: static (L, B, H, S_max, hd) buffers plus
+    the number of valid positions. Static shapes are the point — the whole
+    generate loop compiles to ONE program (prefill + a lax.scan of decode
+    steps) with in-place `dynamic_update_slice` writes, no retracing as
+    the sequence grows (the XLA analog of the reference's nothing: it has
+    no autoregressive models)."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+    pos: jnp.ndarray  # scalar int32
+
+
+def prefill(model: TransformerLM, tokens, s_max: int):
+    """Run the prompt through the model once, capturing per-layer K/V into
+    an ``s_max``-long cache. Returns (last-position logits (B, V), cache).
+    Local attention only (sequence-parallel decode shards the cache — use
+    ring/Ulysses for training, gather to local for decode)."""
+    if model.seq_mode != "local":
+        raise ValueError("prefill/decode require seq_mode='local'")
+    cdt = jnp.dtype(model.compute_dtype)
+    d = model.embed.shape[-1]
+    n, s = tokens.shape
+    x = model.embed[tokens] * math.sqrt(d)
+    x = (x + model.pos_embed[:s]).astype(cdt)
+
+    ks, vs = [], []
+    for blk in model.blocks:
+        x, (k, v) = _block_apply(
+            x, blk, cdt,
+            lambda y, b: model._attention(y, b, return_kv=True),
+        )
+        ks.append(k)
+        vs.append(v)
+    logits = _tied_logits(x[:, -1:], model.embed, cdt)[:, 0]
+    pad = [(0, 0), (0, 0), (0, s_max - s), (0, 0)]
+    cache = KVCache(
+        k=jnp.stack([jnp.pad(k, pad) for k in ks]),
+        v=jnp.stack([jnp.pad(v, pad) for v in vs]),
+        pos=jnp.asarray(s, jnp.int32),
+    )
+    return logits, cache
+
+
+def decode_step(model: TransformerLM, token, cache: KVCache):
+    """One autoregressive step: (B,) token at position ``cache.pos`` →
+    ((B, V) logits, updated cache). Attention reads the full static-shape
+    cache with positions ≥ pos masked — compiler-friendly in exchange for
+    O(S_max) work per step."""
+    cdt = jnp.dtype(model.compute_dtype)
+    d = model.embed.shape[-1]
+    h = model.num_heads
+    hd = d // h
+    n = token.shape[0]
+    pos = cache.pos
+    x = model.embed[token][:, None] * math.sqrt(d)
+    x = (x + jax.lax.dynamic_slice_in_dim(model.pos_embed, pos, 1)).astype(cdt)
+
+    valid = (jnp.arange(cache.k.shape[3]) <= pos)[None, None, None, :]
+    new_k, new_v = cache.k, cache.v
+
+    def cached_attn(i):
+        def attn(y, blk):
+            nonlocal new_k, new_v
+            q, k1, v1 = (
+                _split_heads(y, w, h) for w in (blk.wq, blk.wk, blk.wv)
+            )
+            # one 5-D in-place update per buffer — not gather + rewrite,
+            # which XLA may lower to an O(L·S_max) cache copy per layer
+            new_k = jax.lax.dynamic_update_slice(
+                new_k, k1[None].astype(new_k.dtype), (i, 0, 0, pos, 0)
+            )
+            new_v = jax.lax.dynamic_update_slice(
+                new_v, v1[None].astype(new_v.dtype), (i, 0, 0, pos, 0)
+            )
+            layer_k, layer_v = new_k[i], new_v[i]
+            scores = jnp.matmul(
+                q.astype(cdt),
+                layer_k.transpose(0, 1, 3, 2).astype(cdt),
+                preferred_element_type=jnp.float32,
+            ) / math.sqrt(hd)
+            scores = jnp.where(valid, scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1)
+            out = jnp.matmul(
+                probs.astype(cdt), layer_v.astype(cdt),
+                preferred_element_type=jnp.float32,
+            )
+            proj = out.transpose(0, 2, 1, 3).reshape(n, 1, d).astype(
+                cdt
+            ) @ blk.wo.astype(cdt)
+            return proj, None
+
+        return attn
+
+    for i, blk in enumerate(model.blocks):
+        x, _ = _block_apply(x, blk, cdt, cached_attn(i))
+    logits = _tied_logits(x, model.embed, cdt)[:, 0]
+    # past-capacity poison: at pos >= S_max the cache write would clamp
+    # onto S_max-1 and return plausible-but-wrong logits; pos is traced,
+    # so the honest device-side failure is loud NaNs, not an exception
+    logits = jnp.where(pos < cache.k.shape[3], logits, jnp.nan)
+    return logits, KVCache(k=new_k, v=new_v, pos=pos + 1)
+
+
+@functools.partial(jax.jit, static_argnames=("max_new", "temperature"))
+def generate(
+    model: TransformerLM,
+    prompt,
+    *,
+    max_new: int,
+    temperature: float = 0.0,
+    key=None,
+):
+    """Greedy (temperature=0) or sampled decode of ``max_new`` tokens after
+    ``prompt`` (B, P). One jitted program: prefill + lax.scan over steps.
+    Returns (B, max_new) int32 tokens."""
+    if key is None:
+        key = jax.random.key(0)
+    s_max = prompt.shape[1] + max_new
+    if s_max > model.pos_embed.shape[0]:
+        raise ValueError(
+            f"prompt+max_new={s_max} exceeds max_seq={model.pos_embed.shape[0]}"
+        )
+    logits0, cache = prefill(model, prompt, s_max)
+
+    def pick(logits, k):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(k, logits / temperature).astype(jnp.int32)
+
+    keys = jax.random.split(key, max_new)
+    tok0 = pick(logits0, keys[0])
+
+    # scan max_new-1 steps: the token for step i is picked from step i-1's
+    # logits, so the final logits need no decode step of their own
+    def step(carry, k):
+        tok, cache = carry
+        logits, cache2 = decode_step(model, tok, cache)
+        tok2 = pick(logits, k)
+        return (tok2, cache2), tok2
+
+    if max_new == 1:
+        return tok0[:, None]
+    (_, _), rest = jax.lax.scan(step, (tok0, cache), keys[1:])
+    return jnp.concatenate([tok0[:, None], rest.T], axis=1)  # (B, max_new)
 
 
 def next_token_loss(model: TransformerLM, tokens) -> jnp.ndarray:
@@ -333,6 +522,11 @@ class LMConfig:
     seq_mode: str = arg(
         default="local", help="attention strategy: local | ring | ulysses"
     )
+    compute_dtype: str = arg(
+        default="float32",
+        help="matmul/activation dtype (params stay float32); "
+        "bfloat16 is the TPU-native choice",
+    )
     seed: int = arg(default=0)
 
 
@@ -351,6 +545,7 @@ def run(conf: LMConfig, mesh=None) -> dict:
         num_heads=conf.num_heads,
         seq_mode=conf.seq_mode,
         mesh=mesh if conf.seq_mode != "local" else None,
+        compute_dtype=conf.compute_dtype,
     )
     model = shard_params(model, mesh)
     corpus = synthetic_corpus(200_000, conf.vocab, seed=conf.seed)

@@ -12,7 +12,6 @@ long as no other conftest/plugin imports jax first.
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
@@ -20,12 +19,13 @@ if "xla_force_host_platform_device_count" not in xla_flags:
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
-import jax  # noqa: E402
-
 # The sandbox's sitecustomize may already have imported jax with the TPU
-# platform selected; backend init is lazy, so overriding the config here
-# (before any jax.devices() call) still lands us on the 8-device CPU mesh.
-jax.config.update("jax_platforms", "cpu")
+# platform selected; pin_platform re-asserts cpu before any device use.
+from keystone_tpu.core.runtime import pin_platform  # noqa: E402
+
+pin_platform("cpu")
+
+import jax  # noqa: E402
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
