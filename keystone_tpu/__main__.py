@@ -7,7 +7,6 @@ reference-style class name, so existing KeystoneML invocations map 1:1.
 
 from __future__ import annotations
 
-import os
 import sys
 
 # short name → (module, reference class name)

@@ -181,9 +181,13 @@ class Convolver(Transformer):
     - ``xla``: im2col — materialize patches, normalize, gemm (the
       reference's schedule; the parity baseline the others are tested
       against).
-    - ``fused``: Pallas im2col kernel (:mod:`keystone_tpu.ops.conv_kernel`)
-      keeping the patch matrix in VMEM; kept for single-chip use and as
-      the Pallas exemplar, but measured slower than ``conv`` on v5e.
+
+    A Pallas im2col kernel (``impl="fused"``) existed through round 2 and
+    was retired: per-image im2col with C=3 writes 3-of-128 lanes per
+    store — structurally lane-hostile — and it measured 0.28× the im2col
+    path on v5e while the conv-algebra path won (ROOFLINE.md §5). Folding
+    the normalization *algebraically* around XLA's native conv lowering
+    is the TPU-first answer here, not a hand-written kernel.
 
     ``filters``: (num_filters, patch_size²·C), rows in (dy, dx, c) layout —
     exactly what :class:`Windower`+:class:`ImageVectorizer` sampling or
@@ -201,9 +205,9 @@ class Convolver(Transformer):
     precision: str | None = static_field(default=None)
 
     def __call__(self, batch):
-        if self.impl not in ("auto", "conv", "fused", "xla"):
+        if self.impl not in ("auto", "conv", "xla"):
             raise ValueError(
-                f"Convolver impl={self.impl!r}; expected auto|conv|fused|xla"
+                f"Convolver impl={self.impl!r}; expected auto|conv|xla"
             )
         # every impl computes and emits float32; keeps auto-path output
         # independent of which impl runs
@@ -217,17 +221,6 @@ class Convolver(Transformer):
                 var_constant=self.var_constant,
                 whitener_means=self.whitener_means,
                 precision=self.precision,
-            )
-        if self.impl == "fused":
-            from keystone_tpu.ops import conv_kernel
-
-            return conv_kernel.fused_convolver(
-                batch,
-                self.filters,
-                patch_size=self.patch_size,
-                normalize_patches=self.normalize_patches,
-                var_constant=self.var_constant,
-                whitener_means=self.whitener_means,
             )
         p = extract_patches(batch, self.patch_size)  # (N, oh, ow, k²C)
         if self.normalize_patches:
@@ -329,11 +322,11 @@ class FusedConvRectifyPool(Transformer):
       first keeps the rectifier fused into ``reduce_window``'s operand
       and the concat runs on the tiny pooled map (measured ~12% e2e on
       v5e at the CIFAR random-patch shape, and the 2F map never exists).
-    - ``pallas``: the single fused VMEM kernel
-      (:func:`keystone_tpu.ops.conv_kernel.fused_conv_rectify_pool`).
-      Kept as the exemplar; measured *slower* than ``auto`` on v5e —
-      per-image im2col with C=3 lanes can't compete with XLA's conv.
     - ``unfused``: the literal three-node chain (parity baseline).
+
+    A single fused VMEM Pallas kernel (``impl="pallas"``) existed through
+    round 2 and was retired with the Convolver's kernel — the per-image
+    im2col made it slower than ``auto`` on v5e (ROOFLINE.md §5).
 
     Output is identical in shape/layout to the chain: (N, ph, pw, 2F),
     channels ``[pos | neg]``.
@@ -349,7 +342,7 @@ class FusedConvRectifyPool(Transformer):
     pool_stride: int = static_field(default=13)
     pool_size: int = static_field(default=14)
     pool_fn: str = static_field(default="sum")
-    impl: str = static_field(default="auto")  # auto | pallas | unfused
+    impl: str = static_field(default="auto")  # auto | unfused
 
     def _unfused(self) -> Transformer:
         from keystone_tpu.core.pipeline import Pipeline
@@ -371,29 +364,13 @@ class FusedConvRectifyPool(Transformer):
         )
 
     def __call__(self, batch):
-        if self.impl not in ("auto", "pallas", "unfused"):
+        if self.impl not in ("auto", "unfused"):
             raise ValueError(
                 f"FusedConvRectifyPool impl={self.impl!r}; "
-                "expected auto|pallas|unfused"
+                "expected auto|unfused"
             )
         if self.impl == "unfused":
             return self._unfused()(batch)
-        if self.impl == "pallas":
-            from keystone_tpu.ops import conv_kernel
-
-            return conv_kernel.fused_conv_rectify_pool(
-                batch,
-                self.filters,
-                patch_size=self.patch_size,
-                normalize_patches=self.normalize_patches,
-                var_constant=self.var_constant,
-                whitener_means=self.whitener_means,
-                alpha=self.alpha,
-                max_val=self.max_val,
-                pool_stride=self.pool_stride,
-                pool_size=self.pool_size,
-                pool_fn=self.pool_fn,
-            )
         conv = conv_convolver(
             batch,
             self.filters,

@@ -10,7 +10,9 @@ on the real chip:
   softmax reference;
 - ``flash_attention_step`` (the ring-attention inner kernel) chained over
   hops, both lane-1 and padded state;
-- ``fused_convolver`` (im2col+normalize+gemm) vs the XLA im2col path;
+- ``conv_convolver`` (the production conv-algebra Convolver) vs the XLA
+  im2col path and an f64 numpy truth (the Pallas im2col kernel it also
+  used to measure was retired in round 3 — ROOFLINE.md §5);
 
 asserts numerical agreement and records compiled-vs-jnp timings in
 ``TPU_VALIDATION.json`` at the repo root.
@@ -295,8 +297,7 @@ def validate_flash_step(results):
         )
 
 
-def validate_fused_convolver(results):
-    from keystone_tpu.ops.conv_kernel import fused_convolver
+def validate_conv_convolver(results):
     from keystone_tpu.ops.images import extract_patches, normalize_patch_rows
 
     rng = np.random.default_rng(2)
@@ -335,17 +336,6 @@ def validate_fused_convolver(results):
 
     truth = np_truth()
     ref = jax.jit(xla_path)
-    fused = jax.jit(
-        lambda b_, f_, m_: fused_convolver(
-            b_,
-            f_,
-            patch_size=k,
-            normalize_patches=True,
-            var_constant=10.0,
-            whitener_means=m_,
-            interpret=False,
-        )
-    )
     conv = jax.jit(
         lambda b_, f_, m_: conv_convolver(
             b_,
@@ -356,22 +346,10 @@ def validate_fused_convolver(results):
             whitener_means=m_,
         )
     )
-    err = _max_err(fused(batch, filters, means), truth)
     err_jnp = _max_err(ref(batch, filters, means), truth)
     err_conv = _max_err(conv(batch, filters, means), truth)
     t_ref = _time(ref, batch, filters, means)
-    t_fused = _time(fused, batch, filters, means)
     t_conv = _time(conv, batch, filters, means)
-    results["fused_convolver"] = {
-        "shape": [n, hh, ww, c],
-        "patch": k,
-        "filters": f,
-        "max_err_vs_f64": err,
-        "jnp_err_vs_f64": err_jnp,
-        "jnp_ms": round(t_ref * 1e3, 3),
-        "pallas_ms": round(t_fused * 1e3, 3),
-        "speedup": round(t_ref / t_fused, 2),
-    }
     results["conv_convolver"] = {
         "shape": [n, hh, ww, c],
         "patch": k,
@@ -380,11 +358,7 @@ def validate_fused_convolver(results):
         "im2col_ms": round(t_ref * 1e3, 3),
         "conv_ms": round(t_conv * 1e3, 3),
         "speedup_vs_im2col": round(t_ref / t_conv, 2),
-        "speedup_vs_pallas": round(t_fused / t_conv, 2),
     }
-    assert err < max(4 * err_jnp, 1e-4), (
-        f"fused convolver: err {err} (jnp {err_jnp})"
-    )
     assert err_conv < max(4 * err_jnp, 1e-4), (
         f"conv convolver: err {err_conv} (jnp {err_jnp})"
     )
@@ -528,7 +502,7 @@ def main() -> int:
     }
     validate_flash_attention(results)
     validate_flash_step(results)
-    validate_fused_convolver(results)
+    validate_conv_convolver(results)
     validate_weighted_solver_scale(results)
     if os.environ.get("TPU_VALIDATE_LONG"):
         validate_long_context(results)
