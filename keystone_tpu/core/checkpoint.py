@@ -87,6 +87,53 @@ def _manager(checkpoint_dir: str):
     )
 
 
+def _check_meta(checkpoint_dir, meta_path, meta, what: str) -> None:
+    """Raise if the sidecar identifies a different fit/run."""
+    if not meta_path.exists():
+        return
+    saved = json.loads(meta_path.read_text())
+    if saved != meta:
+        diff = [
+            k for k in set(saved) | set(meta) if saved.get(k) != meta.get(k)
+        ]
+        raise ValueError(
+            f"{checkpoint_dir} holds checkpoints from a different "
+            f"{what} (mismatched: {sorted(diff)}) — resuming would mix "
+            "two runs; point at a fresh directory.\n"
+            f"  saved:   { {k: saved.get(k) for k in diff} }\n"
+            f"  current: { {k: meta.get(k) for k in diff} }"
+        )
+
+
+def _restore_leaves(mgr, step, template, checkpoint_dir, what: str):
+    """Restore ``step``'s leaves into ``template``'s pytree structure via
+    abstract ShapeDtypeStructs (no template FLOPs, no sharding template —
+    restored values are re-placed by the next jit)."""
+    import orbax.checkpoint as ocp
+
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    abstract = [jax.ShapeDtypeStruct(x.shape, x.dtype) for x in leaves]
+    restored = mgr.restore(
+        step, args=ocp.args.StandardRestore({"leaves": abstract})
+    )["leaves"]
+    if len(restored) != len(leaves):
+        raise ValueError(
+            f"{checkpoint_dir} checkpoint has {len(restored)} leaves; "
+            f"this {what}'s state has {len(leaves)} — the directory "
+            "belongs to a different run"
+        )
+    return jax.tree_util.tree_unflatten(treedef, restored)
+
+
+def _write_meta_atomic(meta_path, meta) -> None:
+    # atomic tmp+replace (a crash mid-write must not corrupt the
+    # sidecar), written by process 0 only on multi-host filesystems
+    if jax.process_index() == 0:
+        tmp = meta_path.with_suffix(".json.tmp")
+        tmp.write_text(json.dumps(meta, indent=1))
+        tmp.replace(meta_path)
+
+
 def checkpointed_fit(
     est,
     data,
@@ -162,20 +209,7 @@ def _resumable_fit_inner(
     done = 0
     latest = mgr.latest_step()
     if latest is not None:
-        if meta_path.exists():
-            saved = json.loads(meta_path.read_text())
-            if saved != meta:
-                diff = [
-                    k for k in set(saved) | set(meta)
-                    if saved.get(k) != meta.get(k)
-                ]
-                raise ValueError(
-                    f"{checkpoint_dir} holds checkpoints from a different "
-                    f"fit (mismatched: {sorted(diff)}) — resuming would "
-                    "mix two fits; point at a fresh directory.\n"
-                    f"  saved:   { {k: saved.get(k) for k in diff} }\n"
-                    f"  current: { {k: meta.get(k) for k in diff} }"
-                )
+        _check_meta(checkpoint_dir, meta_path, meta, "fit")
         if int(latest) > total:
             raise ValueError(
                 f"{checkpoint_dir} holds a {latest}-pass checkpoint but "
@@ -197,21 +231,9 @@ def _resumable_fit_inner(
                 data,
                 labels,
             )
-            leaves, treedef = jax.tree_util.tree_flatten(template)
-            abstract = [
-                jax.ShapeDtypeStruct(x.shape, x.dtype) for x in leaves
-            ]
-            restored = mgr.restore(
-                done,
-                args=ocp.args.StandardRestore({"leaves": abstract}),
-            )["leaves"]
-            if len(restored) != len(leaves):
-                raise ValueError(
-                    f"{checkpoint_dir} checkpoint has {len(restored)} "
-                    f"leaves; this fit's model has {len(leaves)} — the "
-                    "directory belongs to a different fit"
-                )
-            model = jax.tree_util.tree_unflatten(treedef, restored)
+            model = _restore_leaves(
+                mgr, done, template, checkpoint_dir, "fit"
+            )
             logger.info(
                 "resuming fit from %s: %d/%d passes done",
                 checkpoint_dir,
@@ -221,13 +243,8 @@ def _resumable_fit_inner(
     if latest is None or not meta_path.exists():
         # overwrite unconditionally when no checkpoint exists yet: a
         # crashed first-chunk run may have left a stale meta that would
-        # otherwise poison every later resume in this directory. Atomic
-        # tmp+replace (a crash mid-write must not corrupt the sidecar),
-        # written by process 0 only on multi-host filesystems.
-        if jax.process_index() == 0:
-            tmp = meta_path.with_suffix(".json.tmp")
-            tmp.write_text(json.dumps(meta, indent=1))
-            tmp.replace(meta_path)
+        # otherwise poison every later resume in this directory
+        _write_meta_atomic(meta_path, meta)
     while done < total:
         step = min(every, total - done)
         chunk_est = dataclasses.replace(est, num_iter=step)
@@ -245,3 +262,75 @@ def _resumable_fit_inner(
             data, labels, n_valid=n_valid
         )
     return model
+
+
+class TrainCheckpointer:
+    """Step-indexed checkpointing for iterative training loops (the LM
+    trainer's analog of :func:`resumable_fit` — same orbax manager, same
+    meta-sidecar identity check, but the state is an arbitrary pytree
+    (model + optimizer state) and the loop owns the step schedule).
+
+    Usage::
+
+        ckpt = TrainCheckpointer(dir, meta)  # meta: JSON-able identity
+        try:
+            state, start = ckpt.restore(state)   # (template, 0) if fresh
+            for step in range(start, total):
+                state = train_step(state)
+                if (step + 1) % every == 0:
+                    ckpt.save(state, step + 1)
+            ckpt.save(state, total)
+        finally:
+            ckpt.close()
+
+    Restore is exact when the loop derives step ``i``'s batch from
+    ``(seed, i)`` rather than sequential RNG draws — the resumed run then
+    replays the identical trajectory (tested for the LM trainer).
+    """
+
+    def __init__(self, checkpoint_dir: str, meta: dict):
+        self._dir = checkpoint_dir
+        self._meta = json.loads(json.dumps(meta, default=str))
+        self._meta_path = (
+            pathlib.Path(checkpoint_dir).absolute() / "train_meta.json"
+        )
+        self._mgr = _manager(checkpoint_dir)
+
+    def restore(self, template):
+        """(state, start_step): the latest checkpoint restored into
+        ``template``'s pytree structure, or ``(template, 0)`` when the
+        directory is fresh. Raises on a meta mismatch (different run) or
+        a leaf-structure mismatch."""
+        latest = self._mgr.latest_step()
+        if latest is None or int(latest) == 0:
+            self._write_meta()
+            return template, 0
+        _check_meta(self._dir, self._meta_path, self._meta, "training run")
+        state = _restore_leaves(
+            self._mgr, latest, template, self._dir, "training run"
+        )
+        if not self._meta_path.exists():
+            # checkpoints without a sidecar: a deleted/crashed meta would
+            # poison later identity checks — rewrite the current one
+            self._write_meta()
+        logger.info(
+            "resuming training from %s: step %d", self._dir, int(latest)
+        )
+        return state, int(latest)
+
+    def save(self, state, step: int) -> None:
+        import orbax.checkpoint as ocp
+
+        self._mgr.save(
+            int(step),
+            args=ocp.args.StandardSave(
+                {"leaves": jax.tree_util.tree_leaves(state)}
+            ),
+        )
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()
+
+    def _write_meta(self) -> None:
+        _write_meta_atomic(self._meta_path, self._meta)

@@ -438,6 +438,15 @@ def make_train_step(optimizer):
     return step
 
 
+def _step_batch(corpus, seed: int, i: int, batch: int, seq: int):
+    """Step ``i``'s token windows, derived from ``(seed, i)`` alone — no
+    sequential RNG state, so a resumed run regenerates the exact batch
+    sequence an uninterrupted run would have seen."""
+    rng = np.random.default_rng(np.random.SeedSequence((seed, i)))
+    starts = rng.integers(0, len(corpus) - seq - 1, size=batch)
+    return np.stack([corpus[s : s + seq + 1] for s in starts])
+
+
 def train(
     model: TransformerLM,
     corpus: np.ndarray,
@@ -449,11 +458,22 @@ def train(
     mesh=None,
     seed: int = 0,
     log_every: int = 0,
+    checkpoint_dir: str = "",
+    checkpoint_every: int = 0,
 ):
     """Train on random windows of ``corpus`` (1-D int array). Returns
     (model, losses). Batches are dp-sharded over the mesh ``data`` axis
     unless the model is sequence-parallel (then S is the sharded axis and
-    the batch is replicated)."""
+    the batch is replicated).
+
+    ``checkpoint_dir`` makes the run preemption-safe: model + optimizer
+    state are orbax-checkpointed every ``checkpoint_every`` steps (default
+    every step when a dir is set), and a rerun with the same arguments
+    resumes from the last completed step on the *identical* trajectory —
+    batches are derived per-step from ``(seed, i)``, not from sequential
+    RNG state (the LM analog of the solvers' ``resumable_fit``). ``losses``
+    covers only the steps this invocation ran.
+    """
     import optax
 
     from keystone_tpu.parallel.mesh import data_sharding
@@ -461,7 +481,6 @@ def train(
     optimizer = optax.adamw(lr, weight_decay=0.01)
     opt_state = optimizer.init(model)
     step = make_train_step(optimizer)
-    rng = np.random.default_rng(seed)
     losses = []
     sharding = None
     if (
@@ -470,18 +489,63 @@ def train(
         and batch % mesh.shape.get("data", 1) == 0
     ):
         sharding = data_sharding(mesh, ndim=2)
-    for i in range(steps):
-        starts = rng.integers(0, len(corpus) - seq - 1, size=batch)
-        toks = np.stack([corpus[s : s + seq + 1] for s in starts])
-        toks = jnp.asarray(toks)
-        if sharding is not None:
-            toks = jax.device_put(toks, sharding)
-        model, opt_state, loss = step(model, opt_state, toks)
-        # keep the loss on device: a float() here would block a host
-        # round-trip into every step and serialize the dispatch queue
-        losses.append(loss)
-        if log_every and (i + 1) % log_every == 0:
-            logger.info("step %d loss %.4f", i + 1, float(loss))
+
+    ckpt = None
+    start = 0
+    if checkpoint_dir:
+        import hashlib
+
+        from keystone_tpu.core.checkpoint import TrainCheckpointer
+
+        every = checkpoint_every or 1
+        corpus_head = np.asarray(corpus[:64], np.int64)
+        ckpt = TrainCheckpointer(
+            checkpoint_dir,
+            # `steps` is deliberately absent (resuming with a longer
+            # schedule is the point — the over-trained guard below covers
+            # the short case), mirroring resumable_fit's num_iter rule
+            {
+                "kind": "lm_transformer",
+                "batch": batch,
+                "seq": seq,
+                "lr": lr,
+                "seed": seed,
+                "corpus_len": int(len(corpus)),
+                "corpus_head_sha": hashlib.sha256(
+                    corpus_head.tobytes()
+                ).hexdigest()[:16],
+                "param_shapes": [
+                    list(map(int, leaf.shape))
+                    for leaf in jax.tree_util.tree_leaves(model)
+                ],
+            },
+        )
+    try:
+        if ckpt is not None:
+            (model, opt_state), start = ckpt.restore((model, opt_state))
+            if start > steps:
+                raise ValueError(
+                    f"{checkpoint_dir} holds a step-{start} checkpoint but "
+                    f"this run is only {steps} steps — refusing to return "
+                    "an over-trained model; point at a fresh directory"
+                )
+        for i in range(start, steps):
+            toks = jnp.asarray(_step_batch(corpus, seed, i, batch, seq))
+            if sharding is not None:
+                toks = jax.device_put(toks, sharding)
+            model, opt_state, loss = step(model, opt_state, toks)
+            # keep the loss on device: a float() here would block a host
+            # round-trip into every step and serialize the dispatch queue
+            losses.append(loss)
+            if log_every and (i + 1) % log_every == 0:
+                logger.info("step %d loss %.4f", i + 1, float(loss))
+            if ckpt is not None and (
+                (i + 1) % every == 0 or (i + 1) == steps
+            ):
+                ckpt.save((model, opt_state), i + 1)
+    finally:
+        if ckpt is not None:
+            ckpt.close()
     return model, [float(l) for l in losses]
 
 
@@ -528,6 +592,13 @@ class LMConfig:
         "bfloat16 is the TPU-native choice",
     )
     seed: int = arg(default=0)
+    checkpoint_dir: str = arg(
+        default="",
+        help="orbax checkpoint/resume directory (preemption-safe training)",
+    )
+    checkpoint_every: int = arg(
+        default=0, help="steps between checkpoints (0 = every step)"
+    )
 
 
 def run(conf: LMConfig, mesh=None) -> dict:
@@ -560,14 +631,20 @@ def run(conf: LMConfig, mesh=None) -> dict:
         mesh=mesh,
         seed=conf.seed,
         log_every=max(conf.steps // 5, 1),
+        checkpoint_dir=conf.checkpoint_dir,
+        checkpoint_every=conf.checkpoint_every,
     )
     dt = time.time() - t0
+    steps_ran = len(losses)
+    if not losses:
+        # a resume that found the run already complete trains 0 steps
+        losses = [float("nan")]
     res = {
         "loss_first": losses[0],
         "loss_last": float(np.mean(losses[-5:])),
         "steps": conf.steps,
         "params": model.num_params(),
-        "tokens_per_s": conf.steps * conf.batch * conf.seq / dt,
+        "tokens_per_s": steps_ran * conf.batch * conf.seq / dt,
         "wall_s": dt,
     }
     logger.info(

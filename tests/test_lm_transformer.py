@@ -172,3 +172,49 @@ def test_cli_main_tiny():
         ]
     )
     assert res["params"] > 0 and np.isfinite(res["loss_last"])
+
+
+def test_checkpoint_resume_exact_trajectory(tmp_path):
+    """A preempted run resumed from its checkpoint must land on the same
+    weights as an uninterrupted run — batches are derived from (seed, i),
+    so the resumed trajectory replays identically (the LM analog of
+    resumable_fit's warm-start-exactness test)."""
+    corpus = lm.synthetic_corpus(5_000, 31, seed=3)
+    kw = dict(steps=6, batch=4, seq=16, lr=1e-3, seed=3)
+
+    ref_model, ref_losses = lm.train(_tiny(), corpus, **kw)
+
+    ckdir = str(tmp_path / "lm_ck")
+    # "preempted" after 3 steps...
+    lm.train(_tiny(), corpus, **{**kw, "steps": 3},
+             checkpoint_dir=ckdir)
+    # ...rerun to completion (restores step 3: the fresh model/opt passed
+    # in are discarded in favor of the checkpoint)
+    res_model, res_losses = lm.train(
+        _tiny(), corpus, **kw, checkpoint_dir=ckdir
+    )
+    assert len(res_losses) == 3  # only steps 3..6 ran here
+    for a, b in zip(
+        jax.tree_util.tree_leaves(ref_model),
+        jax.tree_util.tree_leaves(res_model),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-6
+        )
+    np.testing.assert_allclose(ref_losses[3:], res_losses, atol=1e-5)
+
+
+def test_checkpoint_rejects_mismatched_run(tmp_path):
+    corpus = lm.synthetic_corpus(3_000, 31, seed=4)
+    ckdir = str(tmp_path / "lm_ck2")
+    kw = dict(steps=2, batch=4, seq=16, seed=4)
+    lm.train(_tiny(), corpus, lr=1e-3, **kw, checkpoint_dir=ckdir)
+    # different lr = different run identity -> loud failure
+    with pytest.raises(ValueError, match="different training run"):
+        lm.train(_tiny(), corpus, lr=5e-4, **kw, checkpoint_dir=ckdir)
+    # over-trained guard: asking for fewer steps than are checkpointed
+    with pytest.raises(ValueError, match="over-trained"):
+        lm.train(
+            _tiny(), corpus, lr=1e-3, **{**kw, "steps": 1},
+            checkpoint_dir=ckdir,
+        )
