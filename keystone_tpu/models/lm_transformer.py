@@ -69,13 +69,19 @@ def _split_heads(y, w, h):
     )
 
 
-def _block_apply(x, blk: LMBlock, cdt, attn):
+def _block_apply(x, blk: LMBlock, cdt, attn, moe=None):
     """Pre-LN residual block shared by training forward, prefill, and
-    decode: ``attn(y, blk) -> (attention output (N,S,d), aux)``."""
+    decode: ``attn(y, blk) -> (attention output (N,S,d), aux)``. When
+    ``moe`` is given it replaces the dense FFN; returns
+    (x, attn_aux, moe_aux_loss)."""
     a, aux = attn(_ln(x, cdt), blk)
     x = x + a
-    hdn = _ln(x, cdt) @ blk.w1.astype(cdt)
-    return x + jax.nn.gelu(hdn) @ blk.w2.astype(cdt), aux
+    y = _ln(x, cdt)
+    if moe is not None:
+        f, moe_aux = moe(y)
+        return x + f, aux, moe_aux
+    hdn = y @ blk.w1.astype(cdt)
+    return x + jax.nn.gelu(hdn) @ blk.w2.astype(cdt), aux, jnp.float32(0)
 
 
 def _tied_logits(x, embed, cdt):
@@ -109,6 +115,10 @@ class TransformerLM:
     # traffic and feeds the MXU its native input width). LayerNorm stats
     # and the loss reduction stay float32 regardless.
     compute_dtype: str = static_field(default="float32")
+    # expert parallelism: per-block MoE layers (None entries keep the
+    # dense FFN). Tuple parallel to `blocks`; empty = no MoE anywhere.
+    moe_layers: tuple = ()
+    moe_aux_weight: float = static_field(default=0.01)
 
     def _attention(self, x, blk: LMBlock, return_kv: bool = False):
         n, s, d = x.shape
@@ -151,23 +161,35 @@ class TransformerLM:
             return proj, (k, v)
         return proj
 
+    def _moe(self, i: int):
+        return self.moe_layers[i] if self.moe_layers else None
+
     def __call__(self, tokens):
         """(B, S) int tokens → (B, S, V) float32 logits."""
+        return self.forward_with_aux(tokens)[0]
+
+    def forward_with_aux(self, tokens):
+        """(logits (B, S, V) f32, total MoE load-balance aux loss)."""
         cdt = jnp.dtype(self.compute_dtype)
         d = self.embed.shape[-1]
         x = self.embed[tokens] * math.sqrt(d)
         x = (x + self.pos_embed[: tokens.shape[1]]).astype(cdt)
 
-        def block_fn(x, blk):
-            return _block_apply(
-                x, blk, cdt, lambda y, b: (self._attention(y, b), None)
-            )[0]
+        def block_fn(x, blk, moe):
+            out, _, moe_aux = _block_apply(
+                x, blk, cdt,
+                lambda y, b: (self._attention(y, b), None),
+                moe=moe,
+            )
+            return out, moe_aux
 
         if self.remat:
             block_fn = jax.checkpoint(block_fn)
-        for blk in self.blocks:
-            x = block_fn(x, blk)
-        return _tied_logits(x, self.embed, cdt)
+        aux = jnp.float32(0)
+        for i, blk in enumerate(self.blocks):
+            x, moe_aux = block_fn(x, blk, self._moe(i))
+            aux = aux + moe_aux
+        return _tied_logits(x, self.embed, cdt), aux
 
     @staticmethod
     def create(
@@ -182,25 +204,54 @@ class TransformerLM:
         mesh=None,
         seq_axis: str = "data",
         compute_dtype: str = "float32",
+        moe_every: int = 0,
+        num_experts: int = 8,
+        capacity_factor: float = 1.25,
     ) -> "TransformerLM":
+        """``moe_every=k`` replaces the dense FFN of every k-th block with
+        a top-2 routed :class:`~keystone_tpu.ops.moe.MoELayer` of
+        ``num_experts`` experts (0 = dense everywhere)."""
+        # the split count and per-block stride must not depend on
+        # moe_every: dense models seeded before MoE existed must keep
+        # bit-identical weights, so MoE keys are folded in separately
         keys = jax.random.split(key, 2 + 6 * depth)
 
         def init(k, shape, fan_in):
             return jax.random.normal(k, shape, jnp.float32) / math.sqrt(fan_in)
 
         blocks = []
+        moes = []
         for i in range(depth):
             ks = keys[2 + 6 * i : 8 + 6 * i]
+            is_moe = bool(moe_every) and (i + 1) % moe_every == 0
             blocks.append(
                 LMBlock(
                     wq=init(ks[0], (dim, dim), dim),
                     wk=init(ks[1], (dim, dim), dim),
                     wv=init(ks[2], (dim, dim), dim),
                     wo=init(ks[3], (dim, dim), dim),
-                    w1=init(ks[4], (dim, ff_mult * dim), dim),
-                    w2=init(ks[5], (ff_mult * dim, dim), ff_mult * dim),
+                    # a MoE block's dense FFN is never applied — zero-width
+                    # placeholders keep the pytree structure uniform
+                    # without dead parameters
+                    w1=jnp.zeros((dim, 0), jnp.float32)
+                    if is_moe
+                    else init(ks[4], (dim, ff_mult * dim), dim),
+                    w2=jnp.zeros((0, dim), jnp.float32)
+                    if is_moe
+                    else init(ks[5], (ff_mult * dim, dim), ff_mult * dim),
                 )
             )
+            if is_moe:
+                from keystone_tpu.ops.moe import MoELayer
+
+                moes.append(
+                    MoELayer.create(
+                        jax.random.fold_in(key, 1_000_003 + i),
+                        dim, ff_mult * dim, num_experts, capacity_factor,
+                    )
+                )
+            else:
+                moes.append(None)
         return TransformerLM(
             embed=0.02 * jax.random.normal(keys[0], (vocab, dim)),
             pos_embed=0.02 * jax.random.normal(keys[1], (max_seq, dim)),
@@ -210,6 +261,7 @@ class TransformerLM:
             mesh=mesh,
             seq_axis=seq_axis,
             compute_dtype=compute_dtype,
+            moe_layers=tuple(moes) if moe_every else (),
         )
 
     def num_params(self) -> int:
@@ -255,11 +307,26 @@ def shard_params(model: TransformerLM, mesh) -> TransformerLM:
         )
         for b in model.blocks
     )
+    moes = tuple(
+        m
+        if m is None
+        else dataclasses.replace(
+            m,
+            # expert-parallel: one expert group per model-axis device;
+            # the router stays replicated (every token scores every
+            # expert) — XLA places the dispatch/combine all_to_alls
+            w_router=put(m.w_router, P()),
+            w1=put(m.w1, P("model", None, None)),
+            w2=put(m.w2, P("model", None, None)),
+        )
+        for m in model.moe_layers
+    )
     return dataclasses.replace(
         model,
         embed=put(model.embed, P("model", None)),
         pos_embed=put(model.pos_embed, P()),
         blocks=blocks,
+        moe_layers=moes,
     )
 
 
@@ -291,10 +358,11 @@ def prefill(model: TransformerLM, tokens, s_max: int):
     x = (x + model.pos_embed[:s]).astype(cdt)
 
     ks, vs = [], []
-    for blk in model.blocks:
-        x, (k, v) = _block_apply(
+    for i, blk in enumerate(model.blocks):
+        x, (k, v), _ = _block_apply(
             x, blk, cdt,
             lambda y, b: model._attention(y, b, return_kv=True),
+            moe=model._moe(i),
         )
         ks.append(k)
         vs.append(v)
@@ -359,7 +427,7 @@ def decode_step(model: TransformerLM, token, cache: KVCache):
         return attn
 
     for i, blk in enumerate(model.blocks):
-        x, _ = _block_apply(x, blk, cdt, cached_attn(i))
+        x, _, _ = _block_apply(x, blk, cdt, cached_attn(i), moe=model._moe(i))
     logits = _tied_logits(x, model.embed, cdt)[:, 0]
     # past-capacity poison: at pos >= S_max the cache write would clamp
     # onto S_max-1 and return plausible-but-wrong logits; pos is traced,
@@ -413,12 +481,13 @@ def generate(
 
 def next_token_loss(model: TransformerLM, tokens) -> jnp.ndarray:
     """Mean cross-entropy of predicting ``tokens[:, 1:]`` from the prefix
-    (the model runs on the first S tokens of an S+1 window)."""
-    logits = model(tokens[:, :-1])
+    (the model runs on the first S tokens of an S+1 window), plus the
+    weighted MoE load-balance auxiliary when the model routes."""
+    logits, aux = model.forward_with_aux(tokens[:, :-1])
     targets = tokens[:, 1:]
     logz = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(logz - gold)
+    return jnp.mean(logz - gold) + model.moe_aux_weight * aux
 
 
 def make_train_step(optimizer):
@@ -497,19 +566,39 @@ def train(
 
         from keystone_tpu.core.checkpoint import TrainCheckpointer
 
-        every = checkpoint_every or 1
+        # default cadence: ~10 checkpoints per run, not one per step — a
+        # jitted LM step is milliseconds while a synchronous full-state
+        # orbax save is not (resumable_fit's every=1 default amortizes
+        # over whole BCD passes, a much coarser unit)
+        every = checkpoint_every or max(steps // 10, 1)
         corpus_head = np.asarray(corpus[:64], np.int64)
         ckpt = TrainCheckpointer(
             checkpoint_dir,
             # `steps` is deliberately absent (resuming with a longer
             # schedule is the point — the over-trained guard below covers
-            # the short case), mirroring resumable_fit's num_iter rule
+            # the short case), mirroring resumable_fit's num_iter rule.
+            # Everything else that shapes the trajectory is here: a
+            # param-shape match alone would silently accept a different
+            # model function (num_heads, dtype policy, seq_mode...)
             {
                 "kind": "lm_transformer",
                 "batch": batch,
                 "seq": seq,
                 "lr": lr,
                 "seed": seed,
+                "num_heads": model.num_heads,
+                "seq_mode": model.seq_mode,
+                "compute_dtype": model.compute_dtype,
+                "remat": model.remat,
+                "moe_aux_weight": model.moe_aux_weight,
+                "moe_experts": [
+                    None if m is None else m.num_experts
+                    for m in model.moe_layers
+                ],
+                "moe_capacity": [
+                    None if m is None else m.capacity_factor
+                    for m in model.moe_layers
+                ],
                 "corpus_len": int(len(corpus)),
                 "corpus_head_sha": hashlib.sha256(
                     corpus_head.tobytes()
@@ -550,9 +639,15 @@ def train(
 
 
 def train_step_flops(model: TransformerLM, batch: int, seq: int) -> float:
-    """Analytic FLOPs of one train step: ~6·P·tokens for the matmul work
-    plus the attention score/value terms (12·L·d·S²·B fwd+bwd)."""
+    """Analytic FLOPs of one train step: ~6·P_active·tokens for the matmul
+    work plus the attention score/value terms (12·L·d·S²·B fwd+bwd). For
+    MoE blocks only the ~2 routed experts per token are active, so expert
+    params count at 2/E weight."""
     p = model.num_params()
+    for m in model.moe_layers:
+        if m is not None:
+            expert_p = int(np.prod(m.w1.shape)) + int(np.prod(m.w2.shape))
+            p -= expert_p * (1.0 - min(2.0 / m.num_experts, 1.0))
     tokens = batch * seq
     d = model.embed.shape[-1]
     attn = 12 * len(model.blocks) * d * seq * seq * batch
@@ -592,6 +687,11 @@ class LMConfig:
         "bfloat16 is the TPU-native choice",
     )
     seed: int = arg(default=0)
+    moe_every: int = arg(
+        default=0,
+        help="replace every k-th block's FFN with a top-2 MoE (0 = dense)",
+    )
+    num_experts: int = arg(default=8)
     checkpoint_dir: str = arg(
         default="",
         help="orbax checkpoint/resume directory (preemption-safe training)",
@@ -617,6 +717,8 @@ def run(conf: LMConfig, mesh=None) -> dict:
         seq_mode=conf.seq_mode,
         mesh=mesh if conf.seq_mode != "local" else None,
         compute_dtype=conf.compute_dtype,
+        moe_every=conf.moe_every,
+        num_experts=conf.num_experts,
     )
     model = shard_params(model, mesh)
     corpus = synthetic_corpus(200_000, conf.vocab, seed=conf.seed)
