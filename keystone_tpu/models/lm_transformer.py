@@ -640,15 +640,17 @@ def train(
 
 def train_step_flops(model: TransformerLM, batch: int, seq: int) -> float:
     """Analytic FLOPs of one train step: ~6·P_active·tokens for the matmul
-    work plus the attention score/value terms (12·L·d·S²·B fwd+bwd). For
-    MoE blocks only the ~2 routed experts per token are active, so expert
-    params count at 2/E weight."""
+    work plus the attention score/value terms (12·L·d·S²·B fwd+bwd). MoE
+    expert gemms execute over ALL E·C static capacity slots (drops included
+    — that's the static-shape trade), so expert params count at C/G weight,
+    not the idealized 2/E."""
     p = model.num_params()
+    tokens = batch * seq
     for m in model.moe_layers:
         if m is not None:
             expert_p = int(np.prod(m.w1.shape)) + int(np.prod(m.w2.shape))
-            p -= expert_p * (1.0 - min(2.0 / m.num_experts, 1.0))
-    tokens = batch * seq
+            slots = m.num_experts * m._capacity(tokens)
+            p -= expert_p * (1.0 - min(slots / (tokens * m.num_experts), 1.0))
     d = model.embed.shape[-1]
     attn = 12 * len(model.blocks) * d * seq * seq * batch
     return 6.0 * p * tokens + attn

@@ -95,10 +95,11 @@ def _flash_kernel_fori(
     k_ref,  # (1, s_k_pad, d) — K/V resident in VMEM for this head
     v_ref,
     o_ref,  # (1, block_q, d)
-    *,
+    *maybe_lse,  # (1, block_q, LANE) lse output when with_lse
     scale: float,
     block_k: int,
     causal: bool,
+    with_lse: bool = False,
 ):
     """K/V-resident variant: one program per q block, fori over K blocks.
 
@@ -152,8 +153,13 @@ def _flash_kernel_fori(
     m0 = jnp.full((block_q, 1), _NEG, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc0 = jnp.zeros((block_q, d), jnp.float32)
-    _, l, acc = lax.fori_loop(0, num_k_live, body, (m0, l0, acc0))
+    m, l, acc = lax.fori_loop(0, num_k_live, body, (m0, l0, acc0))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    if with_lse:
+        # row logsumexp of the masked scaled scores — the O(S) residual a
+        # blockwise backward needs (fully masked rows stay at _NEG)
+        lse = m + jnp.log(jnp.maximum(l, 1e-30))
+        maybe_lse[0][0] = jnp.broadcast_to(lse, maybe_lse[0].shape[1:])
 
 
 def _flash_kernel_stream(
@@ -162,13 +168,16 @@ def _flash_kernel_stream(
     k_ref,  # (1, block_k, d) — streamed via the sequential grid dim
     v_ref,
     o_ref,  # (1, block_q, d)
-    m_scr,  # (block_q, LANE) f32 — online-softmax state, lives across
-    l_scr,  # the sequential K grid dimension
-    acc_scr,  # (block_q, d) f32
-    *,
+    *rest,  # [(1, block_q, LANE) lse out when with_lse], then the three
+    # scratch refs: m (block_q, LANE), l (block_q, LANE), acc (block_q, d)
     scale: float,
     causal: bool,
+    with_lse: bool = False,
 ):
+    if with_lse:
+        lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        m_scr, l_scr, acc_scr = rest
     block_q, d = q_ref.shape[1], q_ref.shape[2]
     block_k = k_ref.shape[1]
     kk = pl.program_id(2)
@@ -217,6 +226,9 @@ def _flash_kernel_stream(
     def _finalize():
         l = l_scr[:, :1]
         o_ref[0] = (acc_scr[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        if with_lse:
+            lse = m_scr[:, :1] + jnp.log(jnp.maximum(l, 1e-30))
+            lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
 def flash_attention(
@@ -232,8 +244,14 @@ def flash_attention(
     mxu_dtype=None,
     kv_resident: bool | None = None,
     interpret: bool | None = None,
+    return_lse: bool = False,
 ):
     """Fused attention. q: (B, H, S_q, D); k, v: (B, H, S_k, D).
+
+    ``return_lse=True`` additionally returns the per-row logsumexp of the
+    masked scaled scores, (B, H, S_q) float32 — the O(S) residual the
+    blockwise training backward consumes (computed in-kernel from the
+    online-softmax state; costs one extra lane-tile write, not a sweep).
 
     ``kv_resident`` forces the K/V-in-VMEM variant (True) or the
     streamed long-context variant (False); default None picks by the
@@ -281,8 +299,16 @@ def flash_attention(
     if kv_resident is None:
         budget = 6 * 1024 * 1024 if interpret else _kv_vmem_budget()
         kv_resident = kv_bytes <= budget
+    out_shape = jax.ShapeDtypeStruct((b * h, s_q_pad, d_pad), out_dtype)
+    lse_shape = jax.ShapeDtypeStruct((b * h, s_q_pad, _LANE), jnp.float32)
     if kv_resident:
         # K/V resident in VMEM per program — lowest overhead
+        out_spec = pl.BlockSpec(
+            (1, block_q, d_pad), lambda i, j, *_: (i, j, 0)
+        )
+        lse_spec = pl.BlockSpec(
+            (1, block_q, _LANE), lambda i, j, *_: (i, j, 0)
+        )
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(b * h, s_q_pad // block_q),
@@ -291,12 +317,11 @@ def flash_attention(
                 pl.BlockSpec((1, s_k_pad, d_pad), lambda i, j, *_: (i, 0, 0)),
                 pl.BlockSpec((1, s_k_pad, d_pad), lambda i, j, *_: (i, 0, 0)),
             ],
-            out_specs=pl.BlockSpec(
-                (1, block_q, d_pad), lambda i, j, *_: (i, j, 0)
-            ),
+            out_specs=(out_spec, lse_spec) if return_lse else out_spec,
         )
         kernel = functools.partial(
-            _flash_kernel_fori, scale=scale, block_k=block_k, causal=causal
+            _flash_kernel_fori, scale=scale, block_k=block_k, causal=causal,
+            with_lse=return_lse,
         )
         compiler_params = pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
@@ -305,6 +330,12 @@ def flash_attention(
     else:
         # long-context: stream K/V block-by-block through the pipelined
         # sequential grid dimension, state in VMEM scratch
+        out_spec = pl.BlockSpec(
+            (1, block_q, d_pad), lambda i, j, kk, *_: (i, j, 0)
+        )
+        lse_spec = pl.BlockSpec(
+            (1, block_q, _LANE), lambda i, j, kk, *_: (i, j, 0)
+        )
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(b * h, s_q_pad // block_q, s_k_pad // block_k),
@@ -319,9 +350,7 @@ def flash_attention(
                     (1, block_k, d_pad), lambda i, j, kk, *_: (i, kk, 0)
                 ),
             ],
-            out_specs=pl.BlockSpec(
-                (1, block_q, d_pad), lambda i, j, kk, *_: (i, j, 0)
-            ),
+            out_specs=(out_spec, lse_spec) if return_lse else out_spec,
             scratch_shapes=[
                 pltpu.VMEM((block_q, _LANE), jnp.float32),
                 pltpu.VMEM((block_q, _LANE), jnp.float32),
@@ -329,20 +358,27 @@ def flash_attention(
             ],
         )
         kernel = functools.partial(
-            _flash_kernel_stream, scale=scale, causal=causal
+            _flash_kernel_stream, scale=scale, causal=causal,
+            with_lse=return_lse,
         )
         compiler_params = pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
             vmem_limit_bytes=vmem_limit,
         )
-    out = pl.pallas_call(
+    res = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b * h, s_q_pad, d_pad), out_dtype),
+        out_shape=(out_shape, lse_shape) if return_lse else out_shape,
         compiler_params=compiler_params,
         interpret=interpret,
     )(scalars, qf, kf, vf)
-    return out[:, :s_q, :d].reshape(b, h, s_q, d)
+    if return_lse:
+        out, lse = res
+        return (
+            out[:, :s_q, :d].reshape(b, h, s_q, d),
+            lse[:, :s_q, 0].reshape(b, h, s_q),
+        )
+    return res[:, :s_q, :d].reshape(b, h, s_q, d)
 
 
 def _flash_step_kernel(
@@ -530,6 +566,131 @@ def flash_attention_step(
     )
 
 
+# bytes budget for the dense-recompute backward's transient (S_q, S_k)
+# tensors (~4 of them, f32, per (b, h)): above this the blockwise
+# O(S·block) backward takes over
+_DENSE_BWD_MAX_BYTES = 4 << 30
+_BWD_BLOCK = 512
+
+
+def _dense_bwd_bytes(q, k) -> int:
+    b, h, s_q, _ = q.shape
+    return 4 * 4 * b * h * s_q * k.shape[2]
+
+
+def _bwd_mask(q_pos, k_pos, s_k_valid, causal: bool):
+    """(S_q, blk) validity mask for one KV block (padding + causality).
+
+    Causal positions are BEGIN-aligned (q_pos = i, k_pos = j), matching
+    the flash forward's offset convention at q_offset = k_offset = 0; the
+    trainable wrapper rejects causal s_q != s_k, where begin- and
+    end-aligned conventions diverge."""
+    valid = (k_pos < s_k_valid)[None, :]
+    if causal:
+        valid = valid & (q_pos[:, None] >= k_pos[None, :])
+    return valid
+
+
+# causal backward q-chunking: each chunk sweeps only its live K prefix.
+# More chunks → closer to the ideal 0.5·S² triangle (n chunks execute
+# (n+1)/2n of the rectangle) at the cost of shorter scans; 8 is a good
+# regular-pipelining compromise (0.5625·S²)
+_BWD_CAUSAL_CHUNKS = 8
+
+
+def _grads_rect(qf, kp, vp, gf, delta, lse, q_off, s_k_valid, causal, block):
+    """Rectangle sweep of the blockwise backward over one q range: scan
+    over the given (padded) K/V blocks, recomputing each score block from
+    (q, k, lse). Positions are global begin-aligned (q_off = first q row).
+    Returns (dq, dk, dv) for this rectangle, dk/dv over kp's full padded
+    length. Peak memory O(S·d) state + O(S_q·block) transient."""
+    b, h, s_q, d = qf.shape
+    scale = 1.0 / math.sqrt(d)
+    nb = kp.shape[2] // block
+    kb = jnp.moveaxis(kp.reshape(b, h, nb, block, d), 2, 0)
+    vb = jnp.moveaxis(vp.reshape(b, h, nb, block, d), 2, 0)
+    q_pos = q_off + jnp.arange(s_q)
+
+    def step(dq, inp):
+        kblk, vblk, j = inp
+        kf = kblk.astype(jnp.float32)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
+        k_pos = j * block + jnp.arange(block)
+        mask = _bwd_mask(q_pos, k_pos, s_k_valid, causal)
+        p = jnp.where(mask, jnp.exp(scores - lse[..., None]), 0.0)
+        dv_j = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vblk.astype(jnp.float32))
+        ds = p * (dp - delta[..., None])
+        dq = dq + scale * jnp.einsum("bhqk,bhkd->bhqd", ds, kf)
+        dk_j = scale * jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+        return dq, (dk_j, dv_j)
+
+    dq0 = jnp.zeros((b, h, s_q, d), jnp.float32)
+    dq, (dks, dvs) = jax.lax.scan(step, dq0, (kb, vb, jnp.arange(nb)))
+    dk = jnp.moveaxis(dks, 0, 2).reshape(b, h, nb * block, d)
+    dv = jnp.moveaxis(dvs, 0, 2).reshape(b, h, nb * block, d)
+    return dq, dk, dv
+
+
+def _blockwise_grads(q, k, v, g, out, lse, causal: bool, block: int):
+    """FlashAttention-style backward. Non-causal: one rectangle sweep.
+    Causal: q chunked into block-aligned prefixes, each sweeping only the
+    K blocks at or below its diagonal — ~0.56·S² of score work instead of
+    the full rectangle's 1.0 (the forward kernel's num_k_live analog)."""
+    b, h, s_q, d = q.shape
+    s_k = k.shape[2]
+    nb = -(-s_k // block)
+    pad = nb * block - s_k
+    kp = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    qf = q.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    # delta_i = Σ_d g·out — the softmax-jacobian diagonal term
+    delta = jnp.sum(gf * out.astype(jnp.float32), axis=-1)  # (B, H, S_q)
+
+    if not causal:
+        dq, dk, dv = _grads_rect(
+            qf, kp, vp, gf, delta, lse, 0, s_k, False, block
+        )
+        return (
+            dq.astype(q.dtype),
+            dk[:, :, :s_k].astype(k.dtype),
+            dv[:, :, :s_k].astype(v.dtype),
+        )
+
+    # causal (s_q == s_k enforced by the trainable wrapper): chunk edges
+    # in whole K blocks so each chunk's live prefix is block-aligned
+    n_chunks = min(_BWD_CAUSAL_CHUNKS, nb)
+    edges = sorted({round(nb * c / n_chunks) for c in range(n_chunks + 1)})
+    dq_parts = []
+    dk = jnp.zeros((b, h, nb * block, d), jnp.float32)
+    dv = jnp.zeros_like(dk)
+    for lo, hi in zip(edges[:-1], edges[1:]):
+        q0, q1 = lo * block, min(hi * block, s_q)
+        k_end = hi * block  # K blocks [0, hi) are the live prefix
+        dq_c, dk_c, dv_c = _grads_rect(
+            qf[:, :, q0:q1],
+            kp[:, :, :k_end],
+            vp[:, :, :k_end],
+            gf[:, :, q0:q1],
+            delta[:, :, q0:q1],
+            lse[:, :, q0:q1],
+            q0,
+            s_k,
+            True,
+            block,
+        )
+        dq_parts.append(dq_c)
+        dk = dk.at[:, :, :k_end].add(dk_c)
+        dv = dv.at[:, :, :k_end].add(dv_c)
+    dq = jnp.concatenate(dq_parts, axis=2)
+    return (
+        dq.astype(q.dtype),
+        dk[:, :, :s_k].astype(k.dtype),
+        dv[:, :, :s_k].astype(v.dtype),
+    )
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def flash_attention_trainable(q, k, v, causal: bool = False):
     """Differentiable fused attention: Pallas flash forward, recompute
@@ -539,28 +700,49 @@ def flash_attention_trainable(q, k, v, causal: bool = False):
     the ring/Ulysses per-hop updates). Training needs a VJP: save ONLY
     (q, k, v) from the forward — nothing S²-sized persists between the
     forward and backward (with per-layer remat that's what bounds memory
-    ACROSS the step) — and recompute the attention inside the backward by
-    differentiating the dense formulation. The backward itself does
-    materialize O(B·H·S²) score/probability tensors transiently, so its
-    peak lives at the single layer being differentiated; at the long
-    contexts where even one such tensor cannot fit, use the
-    sequence-parallel paths (ring/Ulysses shard S before the S² term
-    forms). A blockwise-scan backward kernel would remove the transient
-    — current status: forward fused, backward dense-recompute.
+    ACROSS the step). The backward recomputes attention two ways:
+
+    - short context (transient bytes ≤ ``_DENSE_BWD_MAX_BYTES``, counting
+      the B·H multiplier): differentiate the dense formulation — a few
+      transient (S_q, S_k) tensors, fastest at sizes where they fit;
+    - long context: FlashAttention-style blockwise backward — the
+      forward kernel emits the row logsumexp (O(S), in-kernel, no extra
+      sweep), and the backward accumulates dq/dk/dv block by block from
+      (q, k, v, out, lse). Peak memory O(S·d + S_q·block), which is what
+      makes 32k+ causal *training* fit a single chip (the forward kernel
+      alone could stream 32k since round 2; the dense backward could
+      not).
     """
     return flash_attention(q, k, v, causal=causal)
 
 
 def _flash_trainable_fwd(q, k, v, causal: bool):
-    return flash_attention(q, k, v, causal=causal), (q, k, v)
+    if causal and q.shape[2] != k.shape[2]:
+        # the flash forward masks begin-aligned (q_pos >= k_pos at offset
+        # 0) while dense_attention's tril is end-aligned — the two only
+        # agree at s_q == s_k, and the blockwise backward assumes the
+        # forward's convention. Reject rather than return wrong grads.
+        raise ValueError(
+            f"flash_attention_trainable: causal cross-attention with "
+            f"s_q={q.shape[2]} != s_k={k.shape[2]} is ambiguous"
+        )
+    if _dense_bwd_bytes(q, k) <= _DENSE_BWD_MAX_BYTES:
+        # short context: the dense backward needs only (q, k, v)
+        return flash_attention(q, k, v, causal=causal), (q, k, v, None, None)
+    out, lse = flash_attention(q, k, v, causal=causal, return_lse=True)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_trainable_bwd(causal: bool, res, g):
-    from keystone_tpu.ops.attention import dense_attention
+    q, k, v, out, lse = res
+    if out is None:
+        from keystone_tpu.ops.attention import dense_attention
 
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: dense_attention(q, k, v, causal=causal), q, k, v)
-    return vjp(g)
+        _, vjp = jax.vjp(
+            lambda q, k, v: dense_attention(q, k, v, causal=causal), q, k, v
+        )
+        return vjp(g)
+    return _blockwise_grads(q, k, v, g, out, lse, causal, _BWD_BLOCK)
 
 
 flash_attention_trainable.defvjp(_flash_trainable_fwd, _flash_trainable_bwd)

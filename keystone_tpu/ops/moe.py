@@ -18,8 +18,11 @@ where the *sharding layout is the parallelism* —
   stream carries them unchanged) — the standard static-shape trade, and
   the load-balance auxiliary loss keeps drops rare.
 
-Shapes follow the GShard/Switch convention: G = B·S grouped tokens,
-E experts, C capacity slots per expert.
+Shapes follow the GShard/Switch convention: tokens route within
+fixed-size groups (``group_size``; the last group is padded with
+capacity-neutral dummies), E experts, C capacity slots per expert per
+group — bounding the (group, E, C) dispatch/combine tensors to
+O(tokens · group) total instead of O(tokens²).
 """
 
 from __future__ import annotations
@@ -41,6 +44,11 @@ class MoELayer:
     w1: jnp.ndarray  # (E, d, ff)
     w2: jnp.ndarray  # (E, ff, d)
     capacity_factor: float = static_field(default=1.25)
+    # routing group size (GShard's G axis): tokens route within fixed
+    # groups so capacity — and with it the (group, E, C) dispatch/combine
+    # tensors — is bounded per group. Without it C grows with B·S and the
+    # dispatch tensors are O((B·S)²); with it they are O(B·S · group).
+    group_size: int = static_field(default=4096)
 
     @property
     def num_experts(self) -> int:
@@ -48,7 +56,8 @@ class MoELayer:
 
     @staticmethod
     def create(key, dim: int, ff: int, num_experts: int,
-               capacity_factor: float = 1.25) -> "MoELayer":
+               capacity_factor: float = 1.25,
+               group_size: int = 4096) -> "MoELayer":
         kr, k1, k2 = jax.random.split(key, 3)
         return MoELayer(
             w_router=0.02 * jax.random.normal(kr, (dim, num_experts)),
@@ -57,6 +66,7 @@ class MoELayer:
             w2=jax.random.normal(k2, (num_experts, ff, dim))
             / math.sqrt(ff),
             capacity_factor=capacity_factor,
+            group_size=group_size,
         )
 
     def _capacity(self, num_tokens: int) -> int:
@@ -71,67 +81,89 @@ class MoELayer:
     def __call__(self, x):
         """x: (B, S, d) → (out (B, S, d), aux_loss scalar f32)."""
         b, s, d = x.shape
+        g_tot = b * s
+        xf = x.reshape(g_tot, d)
+        gs = min(self.group_size, g_tot)
+        ng = -(-g_tot // gs)
+        pad = ng * gs - g_tot
+        xp = jnp.pad(xf, ((0, pad), (0, 0)))
+        valid = (jnp.arange(ng * gs) < g_tot).reshape(ng, gs)
+        c = self._capacity(gs)
+
+        outs, auxs, counts = jax.vmap(
+            lambda xi, vi: self._route_group(xi, vi, c)
+        )(xp.reshape(ng, gs, d), valid)
+        out = outs.reshape(ng * gs, d)[:g_tot]
+        # per-group aux weighted by real token count (padding excluded)
+        aux = jnp.sum(auxs * counts) / jnp.maximum(jnp.sum(counts), 1.0)
+        return out.reshape(b, s, d), aux
+
+    def _route_group(self, xf, valid, c: int):
+        """Route one group. xf: (gs, d); valid: (gs,) bool marks real
+        tokens (padding claims no capacity and emits zero). Returns
+        (out (gs, d), aux scalar, valid count)."""
         e = self.num_experts
-        g = b * s
-        c = self._capacity(g)
-        xf = x.reshape(g, d)
 
         # --- routing (f32: softmax + cumsum bookkeeping is cheap and
-        # precision-sensitive; the expert gemms below run in x.dtype) ---
+        # precision-sensitive; the expert gemms below run in xf.dtype) ---
         logits = (
             xf.astype(jnp.float32) @ self.w_router.astype(jnp.float32)
-        )  # (G, E)
+        )  # (gs, E)
         probs = jax.nn.softmax(logits, axis=-1)
+        vmask = valid.astype(jnp.float32)[:, None]
 
-        idx1 = jnp.argmax(probs, axis=-1)  # (G,)
-        mask1 = jax.nn.one_hot(idx1, e, dtype=jnp.float32)
+        idx1 = jnp.argmax(probs, axis=-1)  # (gs,)
+        mask1 = jax.nn.one_hot(idx1, e, dtype=jnp.float32) * vmask
         probs2 = probs * (1.0 - mask1)
         idx2 = jnp.argmax(probs2, axis=-1)
-        mask2 = jax.nn.one_hot(idx2, e, dtype=jnp.float32)
+        mask2 = jax.nn.one_hot(idx2, e, dtype=jnp.float32) * vmask
 
-        # load-balance aux: mean one-hot fraction × mean prob, scaled E²
-        # (GShard) — minimized at uniform routing where it equals 1
+        # load-balance aux: mean one-hot fraction × mean prob over REAL
+        # tokens, scaled E² (GShard) — minimized at uniform routing
+        # where it equals 1
+        count = jnp.maximum(jnp.sum(vmask), 1.0)
         aux = jnp.mean(
-            jnp.mean(mask1, axis=0) * jnp.mean(probs, axis=0)
+            (jnp.sum(mask1, axis=0) / count)
+            * (jnp.sum(probs * vmask, axis=0) / count)
         ) * (e * e)
 
         # capacity slots: position of each token within its expert's
         # queue, top-1 claims first, top-2 queues behind all top-1s
-        pos1 = jnp.cumsum(mask1, axis=0) * mask1 - mask1  # (G, E)
+        pos1 = jnp.cumsum(mask1, axis=0) * mask1 - mask1  # (gs, E)
         count1 = jnp.sum(mask1, axis=0, keepdims=True)  # (1, E)
         pos2 = (jnp.cumsum(mask2, axis=0) - mask2 + count1) * mask2
         keep1 = mask1 * (pos1 < c)
         keep2 = mask2 * (pos2 < c)
 
-        gate1 = jnp.sum(probs * keep1, axis=-1)  # (G,)
+        gate1 = jnp.sum(probs * keep1, axis=-1)  # (gs,)
         gate2 = jnp.sum(probs * keep2, axis=-1)
         denom = jnp.maximum(gate1 + gate2, 1e-9)
         gate1, gate2 = gate1 / denom, gate2 / denom
 
         slot1 = jax.nn.one_hot(
             jnp.sum(pos1, axis=-1).astype(jnp.int32), c, dtype=jnp.float32
-        )  # (G, C)
+        )  # (gs, C)
         slot2 = jax.nn.one_hot(
             jnp.sum(pos2, axis=-1).astype(jnp.int32), c, dtype=jnp.float32
         )
-        # (G, E, C) combine weights; dispatch is its 0/1 support
+        # (gs, E, C) combine weights; dispatch is its 0/1 support
         combine = (
             gate1[:, None, None] * keep1[:, :, None] * slot1[:, None, :]
             + gate2[:, None, None] * keep2[:, :, None] * slot2[:, None, :]
         )
-        dispatch = (combine > 0.0).astype(x.dtype)
+        dispatch = (combine > 0.0).astype(xf.dtype)
 
         # --- dispatch → expert gemms → combine (the EP einsums; with the
         # expert axis of w1/w2 sharded over `model`, XLA places
         # all_to_alls here) ---
         expert_in = jnp.einsum("gec,gd->ecd", dispatch, xf)  # (E, C, d)
         h = jax.nn.gelu(
-            jnp.einsum("ecd,edf->ecf", expert_in, self.w1.astype(x.dtype))
+            jnp.einsum("ecd,edf->ecf", expert_in, self.w1.astype(xf.dtype))
         )
         expert_out = jnp.einsum(
-            "ecf,efd->ecd", h, self.w2.astype(x.dtype)
+            "ecf,efd->ecd", h, self.w2.astype(xf.dtype)
         )
         out = jnp.einsum(
-            "gec,ecd->gd", combine.astype(x.dtype), expert_out
+            "gec,ecd->gd", combine.astype(xf.dtype), expert_out
         )
-        return out.reshape(b, s, d), aux
+        return out, aux, jnp.sum(vmask)

@@ -485,6 +485,29 @@ def validate_long_context(results):
         "dense_jnp": "fails to compile (score tensor exceeds HBM)",
     }
 
+    # TRAINING at 32k: flash forward + the blockwise backward (round 3).
+    # The dense-recompute backward cannot run here (one (32k, 32k) f32
+    # tensor is 4 GB, and the VJP holds several); the blockwise scans
+    # peak at O(S·block)
+    from keystone_tpu.ops.flash_attention import flash_attention_trainable
+
+    grad_fn = jax.jit(
+        jax.grad(
+            lambda q, k, v: jnp.sum(
+                flash_attention_trainable(q, k, v, True) ** 2
+            ),
+            argnums=(0, 1, 2),
+        )
+    )
+    t_g = _time(lambda *a: grad_fn(*a)[0], q, k, v, iters=3)
+    # fwd (rerun inside vjp: lse pass) + bwd ≈ 3.5x the fwd flops
+    results["flash_32k_causal_train"] = {
+        "shape": [b, h, s, d],
+        "grad_ms": round(t_g * 1e3, 1),
+        "tflops_per_s": round(3.5 * flops / t_g / 1e12, 2),
+        "note": "fwd+blockwise-bwd; dense bwd cannot fit HBM at 32k",
+    }
+
 
 def main() -> int:
     import os
