@@ -192,6 +192,17 @@ def test_success_persists_tpu_record(monkeypatch, tmp_path, capsys):
     )
     monkeypatch.setattr(bench, "bench_cpu_weighted", lambda: 7.0)
     monkeypatch.setattr(bench, "bench_sift", lambda: {"images_per_s": 2.0})
+    # the LM workloads are NOT fallback-gated mocks elsewhere in this
+    # file because fallback skips them; this test takes the success path,
+    # so unmocked they would train a real dim-1024 LM on the CPU mesh
+    monkeypatch.setattr(
+        bench,
+        "bench_lm_train",
+        lambda: {"tokens_per_s": 3.0, "tflops_per_s": 0.004},
+    )
+    monkeypatch.setattr(
+        bench, "bench_lm_decode", lambda: {"decode_tokens_per_s": 2.0}
+    )
     bench.main()
     saved = json.loads(cache.read_text())
     assert saved["result"]["value"] == 10.0
