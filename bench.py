@@ -335,11 +335,13 @@ LM_DIM, LM_DEPTH, LM_HEADS = 1024, 8, 16
 LM_SEQ, LM_BATCH, LM_VOCAB = 2048, 8, 32_768
 
 
-def bench_lm_train() -> dict:
-    """One sharded LM train step (models/lm_transformer.py): the
-    training-side MFU workload — forward+backward+AdamW as a single
-    buffer-donated program. TPU-only (skipped on the CPU fallback: a
-    ~17 TFLOP step is minutes of host time)."""
+def _lm_train_step_rate(
+    *, seq, dim, depth, heads, batch, pos_encoding="learned",
+    use_mesh=True, iters=3,
+) -> dict:
+    """Shared scaffold for the LM train-step benches: build a bf16-policy
+    remat model, one donated train step, dp-shard the batch when a mesh
+    helps, and time steady-state steps."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -347,15 +349,18 @@ def bench_lm_train() -> dict:
     from keystone_tpu.models import lm_transformer as lm
     from keystone_tpu.parallel.mesh import create_mesh
 
-    mesh = create_mesh() if len(jax.devices()) > 1 else None
+    mesh = (
+        create_mesh() if use_mesh and len(jax.devices()) > 1 else None
+    )
     model = lm.TransformerLM.create(
         jax.random.key(0),
         vocab=LM_VOCAB,
-        max_seq=LM_SEQ,
-        dim=LM_DIM,
-        depth=LM_DEPTH,
-        num_heads=LM_HEADS,
+        max_seq=seq,
+        dim=dim,
+        depth=depth,
+        num_heads=heads,
         compute_dtype="bfloat16",
+        pos_encoding=pos_encoding,
     )
     model = dataclasses.replace(model, remat=True)
     model = lm.shard_params(model, mesh)
@@ -364,18 +369,18 @@ def bench_lm_train() -> dict:
     step = lm.make_train_step(optimizer)
     toks = jnp.asarray(
         np.random.default_rng(0).integers(
-            0, LM_VOCAB, size=(LM_BATCH, LM_SEQ + 1), dtype=np.int32
+            0, LM_VOCAB, size=(batch, seq + 1), dtype=np.int32
         )
     )
     n_chips = 1
-    if mesh is not None and LM_BATCH % mesh.shape.get("data", 1) == 0:
+    if mesh is not None and batch % mesh.shape.get("data", 1) == 0:
         from keystone_tpu.parallel.mesh import data_sharding
 
         # dp-shard the batch; only then is a per-chip divide honest
         # (unsharded, every chip would replicate the full step)
         toks = jax.device_put(toks, data_sharding(mesh, ndim=2))
         n_chips = len(jax.devices())
-    flops = lm.train_step_flops(model, LM_BATCH, LM_SEQ)
+    flops = lm.train_step_flops(model, batch, seq)
     state = [model, opt_state]
 
     def stepper():
@@ -383,12 +388,39 @@ def bench_lm_train() -> dict:
         state[0], state[1] = m2, o2
         return loss
 
-    sec = _timed(stepper, iters=3)
+    sec = _timed(stepper, iters=iters)
     return {
-        "tokens_per_s": LM_BATCH * LM_SEQ / sec,
+        "tokens_per_s": batch * seq / sec,
         "tflops_per_s": flops / sec / 1e12 / n_chips,
         "params": model.num_params(),
     }
+
+
+def bench_lm_train() -> dict:
+    """One sharded LM train step (models/lm_transformer.py): the
+    training-side MFU workload — forward+backward+AdamW as a single
+    buffer-donated program. TPU-only (skipped on the CPU fallback: a
+    ~17 TFLOP step is minutes of host time)."""
+    return _lm_train_step_rate(
+        seq=LM_SEQ, dim=LM_DIM, depth=LM_DEPTH, heads=LM_HEADS,
+        batch=LM_BATCH,
+    )
+
+
+LM_LONG_SEQ, LM_LONG_DIM, LM_LONG_DEPTH = 16_384, 512, 4
+
+
+def bench_lm_longctx() -> dict:
+    """One long-context causal train step (S=16k, rope positions): the
+    attention S² term dominates and the FlashAttention-style blockwise
+    backward carries the step — the dense-recompute backward's transient
+    (S, S) tensors would not fit. TPU-only like bench_lm_train."""
+    res = _lm_train_step_rate(
+        seq=LM_LONG_SEQ, dim=LM_LONG_DIM, depth=LM_LONG_DEPTH, heads=8,
+        batch=1, pos_encoding="rope", use_mesh=False, iters=2,
+    )
+    res.pop("params", None)
+    return res
 
 
 def bench_lm_decode() -> dict:
@@ -609,6 +641,7 @@ def main() -> None:
         sift = bench_sift()
         lm = None if fallback else bench_lm_train()
         lm_dec = None if fallback else bench_lm_decode()
+        lm_long = None if fallback else bench_lm_longctx()
     except Exception as e:  # noqa: BLE001 — tunnel died mid-run
         if fallback:
             raise
@@ -698,6 +731,13 @@ def main() -> None:
     if lm_dec is not None:
         result["lm_decode_tokens_per_s"] = round(
             lm_dec["decode_tokens_per_s"], 1
+        )
+    if lm_long is not None:
+        result["lm_longctx16k_tokens_per_s"] = round(
+            lm_long["tokens_per_s"], 1
+        )
+        result["lm_longctx16k_tflops_per_chip"] = round(
+            lm_long["tflops_per_s"], 2
         )
     if peak is not None and not fallback:
         # "est": featurize FLOPs are an analytic estimate (cosine gemm

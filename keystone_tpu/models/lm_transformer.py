@@ -69,6 +69,22 @@ def _split_heads(y, w, h):
     )
 
 
+def _rope(x, positions, base: float = 10_000.0):
+    """Rotary position embedding. x: (..., S, hd), hd even; positions:
+    (S,) int32 global token positions. Angles in f32 (bf16 loses phase
+    accuracy fast at long context), rotated result back in x.dtype."""
+    hd = x.shape[-1]
+    half = hd // 2
+    inv = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    freqs = positions.astype(jnp.float32)[:, None] * inv  # (S, half)
+    cos, sin = jnp.cos(freqs), jnp.sin(freqs)
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    ).astype(x.dtype)
+
+
 def _block_apply(x, blk: LMBlock, cdt, attn, moe=None):
     """Pre-LN residual block shared by training forward, prefill, and
     decode: ``attn(y, blk) -> (attention output (N,S,d), aux)``. When
@@ -119,6 +135,10 @@ class TransformerLM:
     # dense FFN). Tuple parallel to `blocks`; empty = no MoE anywhere.
     moe_layers: tuple = ()
     moe_aux_weight: float = static_field(default=0.01)
+    # "learned" = trained absolute table (pos_embed, capped at max_seq);
+    # "rope" = rotary q/k phases — no table, no length cap beyond memory,
+    # the right pairing for the blockwise long-context backward
+    pos_encoding: str = static_field(default="learned")
 
     def _attention(self, x, blk: LMBlock, return_kv: bool = False):
         n, s, d = x.shape
@@ -127,6 +147,12 @@ class TransformerLM:
         q, k, v = (
             _split_heads(x, w, h) for w in (blk.wq, blk.wk, blk.wv)
         )
+        if self.pos_encoding == "rope":
+            # x is always the full (global) sequence here — the
+            # sequence-parallel paths shard inside ring/ulysses_attention
+            positions = jnp.arange(s)
+            q = _rope(q, positions)
+            k = _rope(k, positions)
         # the sequence-parallel paths pin use_flash=False: the per-hop
         # Pallas kernels are forward-only, and training differentiates
         # through the ring/all-to-all — the jnp blockwise update is
@@ -173,7 +199,9 @@ class TransformerLM:
         cdt = jnp.dtype(self.compute_dtype)
         d = self.embed.shape[-1]
         x = self.embed[tokens] * math.sqrt(d)
-        x = (x + self.pos_embed[: tokens.shape[1]]).astype(cdt)
+        if self.pos_encoding == "learned":
+            x = x + self.pos_embed[: tokens.shape[1]]
+        x = x.astype(cdt)
 
         def block_fn(x, blk, moe):
             out, _, moe_aux = _block_apply(
@@ -207,10 +235,22 @@ class TransformerLM:
         moe_every: int = 0,
         num_experts: int = 8,
         capacity_factor: float = 1.25,
+        pos_encoding: str = "learned",
     ) -> "TransformerLM":
         """``moe_every=k`` replaces the dense FFN of every k-th block with
         a top-2 routed :class:`~keystone_tpu.ops.moe.MoELayer` of
-        ``num_experts`` experts (0 = dense everywhere)."""
+        ``num_experts`` experts (0 = dense everywhere).
+        ``pos_encoding="rope"`` drops the learned table (and its max_seq
+        cap) for rotary q/k phases."""
+        if pos_encoding not in ("learned", "rope"):
+            raise ValueError(
+                f"pos_encoding={pos_encoding!r}; expected learned|rope"
+            )
+        if pos_encoding == "rope" and (dim // num_heads) % 2:
+            raise ValueError(
+                f"rope needs an even head dim; got dim/num_heads = "
+                f"{dim}/{num_heads} = {dim // num_heads}"
+            )
         # the split count and per-block stride must not depend on
         # moe_every: dense models seeded before MoE existed must keep
         # bit-identical weights, so MoE keys are folded in separately
@@ -254,7 +294,10 @@ class TransformerLM:
                 moes.append(None)
         return TransformerLM(
             embed=0.02 * jax.random.normal(keys[0], (vocab, dim)),
-            pos_embed=0.02 * jax.random.normal(keys[1], (max_seq, dim)),
+            # rope keeps a zero-width placeholder: no table params, no cap
+            pos_embed=jnp.zeros((0, dim), jnp.float32)
+            if pos_encoding == "rope"
+            else 0.02 * jax.random.normal(keys[1], (max_seq, dim)),
             blocks=tuple(blocks),
             num_heads=num_heads,
             seq_mode=seq_mode,
@@ -262,6 +305,7 @@ class TransformerLM:
             seq_axis=seq_axis,
             compute_dtype=compute_dtype,
             moe_layers=tuple(moes) if moe_every else (),
+            pos_encoding=pos_encoding,
         )
 
     def num_params(self) -> int:
@@ -355,7 +399,9 @@ def prefill(model: TransformerLM, tokens, s_max: int):
     d = model.embed.shape[-1]
     n, s = tokens.shape
     x = model.embed[tokens] * math.sqrt(d)
-    x = (x + model.pos_embed[:s]).astype(cdt)
+    if model.pos_encoding == "learned":
+        x = x + model.pos_embed[:s]
+    x = x.astype(cdt)
 
     ks, vs = [], []
     for i, blk in enumerate(model.blocks):
@@ -388,7 +434,9 @@ def decode_step(model: TransformerLM, token, cache: KVCache):
     n = token.shape[0]
     pos = cache.pos
     x = model.embed[token][:, None] * math.sqrt(d)
-    x = (x + jax.lax.dynamic_slice_in_dim(model.pos_embed, pos, 1)).astype(cdt)
+    if model.pos_encoding == "learned":
+        x = x + jax.lax.dynamic_slice_in_dim(model.pos_embed, pos, 1)
+    x = x.astype(cdt)
 
     valid = (jnp.arange(cache.k.shape[3]) <= pos)[None, None, None, :]
     new_k, new_v = cache.k, cache.v
@@ -399,6 +447,11 @@ def decode_step(model: TransformerLM, token, cache: KVCache):
             q, k1, v1 = (
                 _split_heads(y, w, h) for w in (blk.wq, blk.wk, blk.wv)
             )
+            if model.pos_encoding == "rope":
+                # rotate the single new q/k at its global position; cached
+                # keys were stored rotated by prefill / earlier steps
+                q = _rope(q, pos[None])
+                k1 = _rope(k1, pos[None])
             # one 5-D in-place update per buffer — not gather + rewrite,
             # which XLA may lower to an O(L·S_max) cache copy per layer
             new_k = jax.lax.dynamic_update_slice(
@@ -451,7 +504,7 @@ def generate(
     if key is None:
         key = jax.random.key(0)
     s_max = prompt.shape[1] + max_new
-    if s_max > model.pos_embed.shape[0]:
+    if model.pos_encoding == "learned" and s_max > model.pos_embed.shape[0]:
         raise ValueError(
             f"prompt+max_new={s_max} exceeds max_seq={model.pos_embed.shape[0]}"
         )
@@ -589,6 +642,7 @@ def train(
                 "num_heads": model.num_heads,
                 "seq_mode": model.seq_mode,
                 "compute_dtype": model.compute_dtype,
+                "pos_encoding": model.pos_encoding,
                 "remat": model.remat,
                 "moe_aux_weight": model.moe_aux_weight,
                 "moe_experts": [
@@ -694,6 +748,9 @@ class LMConfig:
         help="replace every k-th block's FFN with a top-2 MoE (0 = dense)",
     )
     num_experts: int = arg(default=8)
+    pos_encoding: str = arg(
+        default="learned", help="position encoding: learned | rope"
+    )
     checkpoint_dir: str = arg(
         default="",
         help="orbax checkpoint/resume directory (preemption-safe training)",
@@ -721,6 +778,7 @@ def run(conf: LMConfig, mesh=None) -> dict:
         compute_dtype=conf.compute_dtype,
         moe_every=conf.moe_every,
         num_experts=conf.num_experts,
+        pos_encoding=conf.pos_encoding,
     )
     model = shard_params(model, mesh)
     corpus = synthetic_corpus(200_000, conf.vocab, seed=conf.seed)

@@ -203,6 +203,11 @@ def test_success_persists_tpu_record(monkeypatch, tmp_path, capsys):
     monkeypatch.setattr(
         bench, "bench_lm_decode", lambda: {"decode_tokens_per_s": 2.0}
     )
+    monkeypatch.setattr(
+        bench,
+        "bench_lm_longctx",
+        lambda: {"tokens_per_s": 1.0, "tflops_per_s": 0.002},
+    )
     bench.main()
     saved = json.loads(cache.read_text())
     assert saved["result"]["value"] == 10.0

@@ -218,3 +218,38 @@ def test_checkpoint_rejects_mismatched_run(tmp_path):
             _tiny(), corpus, lr=1e-3, **{**kw, "steps": 1},
             checkpoint_dir=ckdir,
         )
+
+
+def test_rope_trains_decodes_and_extends():
+    """RoPE positions: loss decreases, KV-cache decode matches the full
+    forward, and generation runs past any learned-table bound (the model
+    has no pos_embed params at all)."""
+    model = lm.TransformerLM.create(
+        jax.random.key(0), vocab=31, max_seq=16, dim=32, depth=2,
+        num_heads=2, pos_encoding="rope",
+    )
+    assert model.pos_embed.shape[0] == 0
+    corpus = lm.synthetic_corpus(20_000, 31, seed=1)
+    model, losses = lm.train(
+        model, corpus, steps=40, batch=8, seq=32, lr=2e-3, seed=1
+    )
+    assert np.mean(losses[-5:]) < 0.75 * losses[0]
+
+    # greedy decode == argmax of the full forward, step by step
+    prompt = jnp.asarray([[1, 2, 3, 4]])
+    toks = lm.generate(model, prompt, max_new=6)
+    seq = np.asarray(prompt)[0].tolist()
+    for t in range(6):
+        logits = model(jnp.asarray([seq]))
+        nxt = int(jnp.argmax(logits[0, -1]))
+        assert nxt == int(toks[0, t]), (t, nxt, int(toks[0, t]))
+        seq.append(nxt)
+    # max_seq=16 would bound a learned model; rope ran to 10 tokens of
+    # context and could go further — also check the learned guard still
+    # fires for comparison
+    learned = lm.TransformerLM.create(
+        jax.random.key(0), vocab=31, max_seq=8, dim=32, depth=2,
+        num_heads=2,
+    )
+    with pytest.raises(ValueError, match="exceeds max_seq"):
+        lm.generate(learned, prompt, max_new=8)
