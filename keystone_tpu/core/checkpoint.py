@@ -87,11 +87,19 @@ def _manager(checkpoint_dir: str):
     )
 
 
-def _check_meta(checkpoint_dir, meta_path, meta, what: str) -> None:
-    """Raise if the sidecar identifies a different fit/run."""
+def _check_meta(
+    checkpoint_dir, meta_path, meta, what: str, legacy_defaults=None
+) -> None:
+    """Raise if the sidecar identifies a different fit/run.
+
+    ``legacy_defaults`` fills keys absent from an older sidecar with the
+    value the code used before the key existed — adding a new meta field
+    must not brick every checkpoint written before it."""
     if not meta_path.exists():
         return
     saved = json.loads(meta_path.read_text())
+    if legacy_defaults:
+        saved = {**{k: v for k, v in legacy_defaults.items()}, **saved}
     if saved != meta:
         diff = [
             k for k in set(saved) | set(meta) if saved.get(k) != meta.get(k)
@@ -288,9 +296,11 @@ class TrainCheckpointer:
     replays the identical trajectory (tested for the LM trainer).
     """
 
-    def __init__(self, checkpoint_dir: str, meta: dict):
+    def __init__(self, checkpoint_dir: str, meta: dict,
+                 legacy_defaults: dict | None = None):
         self._dir = checkpoint_dir
         self._meta = json.loads(json.dumps(meta, default=str))
+        self._legacy = legacy_defaults or {}
         self._meta_path = (
             pathlib.Path(checkpoint_dir).absolute() / "train_meta.json"
         )
@@ -305,7 +315,10 @@ class TrainCheckpointer:
         if latest is None or int(latest) == 0:
             self._write_meta()
             return template, 0
-        _check_meta(self._dir, self._meta_path, self._meta, "training run")
+        _check_meta(
+            self._dir, self._meta_path, self._meta, "training run",
+            legacy_defaults=self._legacy,
+        )
         state = _restore_leaves(
             self._mgr, latest, template, self._dir, "training run"
         )

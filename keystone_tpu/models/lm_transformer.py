@@ -153,19 +153,20 @@ class TransformerLM:
             positions = jnp.arange(s)
             q = _rope(q, positions)
             k = _rope(k, positions)
-        # the sequence-parallel paths pin use_flash=False: the per-hop
-        # Pallas kernels are forward-only, and training differentiates
-        # through the ring/all-to-all — the jnp blockwise update is
-        # differentiable end-to-end (ppermute/all_to_all have transposes)
+        # sequence-parallel training runs the custom-VJP bodies: the ring
+        # backward circulates dk/dv accumulators around the ring (the
+        # per-hop Pallas forward kernels are forward-only), Ulysses
+        # differentiates the flash trainable wrapper through all_to_all.
+        # use_flash auto-selects: Pallas-rate on TPU, jnp off it.
         if self.seq_mode == "ring":
             out = ring_attention(
                 q, k, v, self.mesh, seq_axis=self.seq_axis, causal=True,
-                use_flash=False,
+                trainable=True,
             )
         elif self.seq_mode == "ulysses":
             out = ulysses_attention(
                 q, k, v, self.mesh, seq_axis=self.seq_axis, causal=True,
-                use_flash=False,
+                trainable=True,
             )
         else:
             from keystone_tpu.ops.flash_attention import on_tpu
@@ -662,6 +663,10 @@ def train(
                     for leaf in jax.tree_util.tree_leaves(model)
                 ],
             },
+            # keys added after checkpoints already existed in the wild:
+            # an older sidecar without them must compare as the value the
+            # code used at the time, not brick the resume
+            legacy_defaults={"pos_encoding": "learned"},
         )
     try:
         if ckpt is not None:

@@ -48,16 +48,12 @@ def dense_attention(q, k, v, *, causal: bool = False):
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
-def _ring_attention_shard(
-    q, k, v, *, axis_name: str, causal: bool, use_flash: bool
-):
-    """Per-shard ring attention body (runs under shard_map).
-
-    q, k, v: (B, H, S_local, D) — this chip's sequence shard. With
-    ``use_flash`` the per-hop blockwise update runs as the fused Pallas
-    kernel (:func:`keystone_tpu.ops.flash_attention.flash_attention_step`);
-    the K/V rotation stays an XLA ``ppermute`` over ICI either way.
-    """
+def _ring_fwd_state(q, k, v, *, axis_name: str, causal: bool,
+                    use_flash: bool):
+    """Ring forward returning (out, lse). lse is the per-row logsumexp of
+    the full (all-hops) masked score matrix, (B, H, S_local) f32 — the
+    O(S) residual the ring backward consumes; fully masked rows carry
+    -1e30."""
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, h, s_local, d = q.shape
@@ -96,17 +92,27 @@ def _ring_attention_shard(
                 perm = [(j, (j + 1) % n) for j in range(n)]
                 k_blk = lax.ppermute(k_blk, axis_name, perm)
                 v_blk = lax.ppermute(v_blk, axis_name, perm)
-        out = acc / jnp.maximum(l[..., :1], 1e-30)
-        return out.astype(q.dtype)
+        out = (acc / jnp.maximum(l[..., :1], 1e-30)).astype(q.dtype)
+        lse = m[..., 0] + jnp.log(jnp.maximum(l[..., 0], 1e-30))
+        return out, lse
 
-    m = jnp.full((b, h, s_local, 1), -jnp.inf, q.dtype)
-    l = jnp.zeros((b, h, s_local, 1), q.dtype)
-    acc = jnp.zeros_like(q)
+    # softmax state in f32 regardless of q.dtype: lse is load-bearing for
+    # the trainable backward, and a bf16 lse (abs err ~0.04 at lse≈10)
+    # would denormalize every recomputed probability row
+    m = jnp.full((b, h, s_local, 1), -jnp.inf, jnp.float32)
+    l = jnp.zeros((b, h, s_local, 1), jnp.float32)
+    acc = jnp.zeros((b, h, s_local, d), jnp.float32)
 
     k_blk, v_blk = k, v
     for step in range(n):
         owner = (idx - step) % n  # which chip's K/V block we hold now
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk) * scale
+        scores = (
+            jnp.einsum(
+                "bhqd,bhkd->bhqk", q, k_blk,
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )
         if causal:
             k_pos = owner * s_local + jnp.arange(s_local)
             mask = q_pos[:, None] >= k_pos[None, :]
@@ -120,13 +126,132 @@ def _ring_attention_shard(
             jnp.isfinite(m), jnp.exp(m - m_safe), jnp.zeros_like(m)
         )
         l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * alpha + jnp.einsum("bhqk,bhkd->bhqd", p, v_blk)
+        acc = acc * alpha + jnp.einsum(
+            "bhqk,bhkd->bhqd", p.astype(v_blk.dtype), v_blk,
+            preferred_element_type=jnp.float32,
+        )
         m = m_new
         if step + 1 < n:
             perm = [(j, (j + 1) % n) for j in range(n)]
             k_blk = lax.ppermute(k_blk, axis_name, perm)
             v_blk = lax.ppermute(v_blk, axis_name, perm)
-    return acc / jnp.maximum(l, 1e-30)
+    out = (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+    lse = jnp.where(
+        jnp.isfinite(m[..., 0]),
+        m[..., 0] + jnp.log(jnp.maximum(l[..., 0], 1e-30)),
+        -1e30,
+    )
+    return out, lse
+
+
+def _ring_attention_shard(
+    q, k, v, *, axis_name: str, causal: bool, use_flash: bool
+):
+    """Per-shard ring attention body (runs under shard_map).
+
+    q, k, v: (B, H, S_local, D) — this chip's sequence shard. With
+    ``use_flash`` the per-hop blockwise update runs as the fused Pallas
+    kernel (:func:`keystone_tpu.ops.flash_attention.flash_attention_step`);
+    the K/V rotation stays an XLA ``ppermute`` over ICI either way.
+    """
+    return _ring_fwd_state(
+        q, k, v, axis_name=axis_name, causal=causal, use_flash=use_flash
+    )[0]
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_shard_trainable(q, k, v, axis_name, causal, use_flash):
+    """Differentiable per-shard ring attention: flash-rate forward, ring
+    backward. The backward circulates each K/V shard around the ring a
+    second time together with its grad accumulators — per hop it
+    recomputes that rectangle's probabilities from (q, k, lse) with the
+    blockwise machinery (never an (S, S) tensor), adds dq locally and
+    dk/dv into the traveling accumulators, then one final ppermute brings
+    every accumulator home. Exactly n extra ppermutes over ICI; memory
+    O(S_local·d)."""
+    return _ring_fwd_state(
+        q, k, v, axis_name=axis_name, causal=causal, use_flash=use_flash
+    )[0]
+
+
+def _ring_trainable_fwd(q, k, v, axis_name, causal, use_flash):
+    out, lse = _ring_fwd_state(
+        q, k, v, axis_name=axis_name, causal=causal, use_flash=use_flash
+    )
+    return out, (q, k, v, out, lse)
+
+
+def _ring_trainable_bwd(axis_name, causal, use_flash, res, g):
+    from keystone_tpu.ops.flash_attention import _BWD_BLOCK, _grads_rect
+
+    q, k, v, out, lse = res
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, h, s_local, d = q.shape
+    q_off = idx * s_local
+
+    qf = q.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    delta = jnp.sum(gf * out.astype(jnp.float32), axis=-1)
+
+    blk = _BWD_BLOCK if s_local > _BWD_BLOCK else -(-s_local // 8) * 8
+    pad = -(-s_local // blk) * blk - s_local
+
+    dq = jnp.zeros((b, h, s_local, d), jnp.float32)
+    k_blk, v_blk = k, v
+    dk_blk = jnp.zeros((b, h, s_local, d), jnp.float32)
+    dv_blk = jnp.zeros_like(dk_blk)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    for step in range(n):
+        owner = (idx - step) % n
+        k_off = owner * s_local
+
+        def hop_grads(k_blk, v_blk, k_off):
+            kp = jnp.pad(
+                k_blk.astype(jnp.float32),
+                ((0, 0), (0, 0), (0, pad), (0, 0)),
+            )
+            vp = jnp.pad(
+                v_blk.astype(jnp.float32),
+                ((0, 0), (0, 0), (0, pad), (0, 0)),
+            )
+            return _grads_rect(
+                qf, kp, vp, gf, delta, lse, q_off, k_off + s_local,
+                causal, blk, k_off=k_off,
+            )
+
+        if causal:
+            # hops whose K/V shard is entirely in this chip's future are
+            # fully masked — skip their three dead gemm sweeps (the
+            # ppermutes below stay unconditional: the ring must rotate)
+            dq_c, dk_c, dv_c = lax.cond(
+                owner <= idx,
+                hop_grads,
+                lambda k_, v_, o_: (
+                    jnp.zeros_like(dq),
+                    jnp.zeros((b, h, pad + s_local, d), jnp.float32),
+                    jnp.zeros((b, h, pad + s_local, d), jnp.float32),
+                ),
+                k_blk, v_blk, k_off,
+            )
+        else:
+            dq_c, dk_c, dv_c = hop_grads(k_blk, v_blk, k_off)
+        dq = dq + dq_c
+        dk_blk = dk_blk + dk_c[:, :, :s_local]
+        dv_blk = dv_blk + dv_c[:, :, :s_local]
+        if step + 1 < n:
+            k_blk = lax.ppermute(k_blk, axis_name, perm)
+            v_blk = lax.ppermute(v_blk, axis_name, perm)
+            dk_blk = lax.ppermute(dk_blk, axis_name, perm)
+            dv_blk = lax.ppermute(dv_blk, axis_name, perm)
+    # after n-1 rotations shard s (and its accumulated grads) sits on chip
+    # s-1; one final hop sends every accumulator home
+    dk_blk = lax.ppermute(dk_blk, axis_name, perm)
+    dv_blk = lax.ppermute(dv_blk, axis_name, perm)
+    return dq.astype(q.dtype), dk_blk.astype(k.dtype), dv_blk.astype(v.dtype)
+
+
+_ring_shard_trainable.defvjp(_ring_trainable_fwd, _ring_trainable_bwd)
 
 
 def ring_attention(
@@ -138,39 +263,55 @@ def ring_attention(
     seq_axis: str = "data",
     causal: bool = False,
     use_flash: bool | None = None,
+    trainable: bool = False,
 ):
     """Exact attention with the sequence axis sharded over ``seq_axis``.
 
     q, k, v: (B, H, S, D) global arrays (S divisible by the axis size).
     ``use_flash`` selects the fused Pallas per-hop kernel (default: on
-    when running on TPU).
+    when running on TPU). ``trainable`` swaps in the custom-VJP shard
+    body (ring backward with traveling dk/dv accumulators) — required to
+    differentiate the flash path (its kernels are forward-only), and
+    blockwise-memory-bounded for the jnp path too.
     """
     if use_flash is None:
         use_flash = _flash_default()
     spec = P(None, None, seq_axis, None)
-    fn = jax.shard_map(
-        partial(
+    if trainable:
+        body = lambda q_, k_, v_: _ring_shard_trainable(  # noqa: E731
+            q_, k_, v_, seq_axis, causal, use_flash
+        )
+    else:
+        body = partial(
             _ring_attention_shard,
             axis_name=seq_axis,
             causal=causal,
             use_flash=use_flash,
-        ),
+        )
+    fn = jax.shard_map(
+        body,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        # pallas_call outputs carry no varying-mesh-axis metadata; skip the
-        # vma consistency check on the flash path
-        check_vma=not use_flash,
+        # pallas_call outputs carry no varying-mesh-axis metadata, and the
+        # trainable backward's zero-initialized scan carries start
+        # device-invariant before accumulating device-varying grads —
+        # both trip the vma consistency check spuriously
+        check_vma=not (use_flash or trainable),
     )
     return fn(q, k, v)
 
 
-def _ulysses_shard(q, k, v, *, axis_name: str, causal: bool, use_flash: bool):
+def _ulysses_shard(q, k, v, *, axis_name: str, causal: bool,
+                   use_flash: bool, trainable: bool = False):
     """All-to-all sequence↔head resharding (DeepSpeed-Ulysses style).
 
     In: (B, H, S_local, D) sequence-sharded → all_to_all → (B, H/n, S, D)
     head-sharded → local attention over the full sequence (fused Pallas
     flash kernel on TPU, dense jnp otherwise) → all_to_all back.
+    ``trainable`` uses the flash trainable wrapper for the local part —
+    ``all_to_all`` is linear, so JAX transposes it in the backward on its
+    own; only the attention kernel needs the custom VJP.
     """
 
     def seq_to_heads(x):
@@ -185,7 +326,13 @@ def _ulysses_shard(q, k, v, *, axis_name: str, causal: bool, use_flash: bool):
         )
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    if use_flash:
+    if use_flash and trainable:
+        from keystone_tpu.ops.flash_attention import (
+            flash_attention_trainable,
+        )
+
+        out = flash_attention_trainable(qh, kh, vh, causal)
+    elif use_flash:
         from keystone_tpu.ops.flash_attention import flash_attention
 
         out = flash_attention(qh, kh, vh, causal=causal)
@@ -203,11 +350,13 @@ def ulysses_attention(
     seq_axis: str = "data",
     causal: bool = False,
     use_flash: bool | None = None,
+    trainable: bool = False,
 ):
     """Exact attention via all-to-all head/sequence resharding.
 
     Requires H divisible by the axis size. Prefers ICI bandwidth over ring
-    latency — the usual pick when heads are plentiful.
+    latency — the usual pick when heads are plentiful. ``trainable``
+    makes the flash path differentiable (blockwise recompute backward).
     """
     if use_flash is None:
         use_flash = _flash_default()
@@ -221,6 +370,7 @@ def ulysses_attention(
             axis_name=seq_axis,
             causal=causal,
             use_flash=use_flash,
+            trainable=trainable,
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),

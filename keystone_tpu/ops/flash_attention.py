@@ -598,12 +598,15 @@ def _bwd_mask(q_pos, k_pos, s_k_valid, causal: bool):
 _BWD_CAUSAL_CHUNKS = 8
 
 
-def _grads_rect(qf, kp, vp, gf, delta, lse, q_off, s_k_valid, causal, block):
+def _grads_rect(qf, kp, vp, gf, delta, lse, q_off, s_k_valid, causal, block,
+                k_off=0):
     """Rectangle sweep of the blockwise backward over one q range: scan
     over the given (padded) K/V blocks, recomputing each score block from
-    (q, k, lse). Positions are global begin-aligned (q_off = first q row).
-    Returns (dq, dk, dv) for this rectangle, dk/dv over kp's full padded
-    length. Peak memory O(S·d) state + O(S_q·block) transient."""
+    (q, k, lse). Positions are global begin-aligned (q_off / k_off = the
+    global position of the first q / k row — nonzero k_off serves the
+    ring backward's rotating K/V shards). Returns (dq, dk, dv) for this
+    rectangle, dk/dv over kp's full padded length. Peak memory O(S·d)
+    state + O(S_q·block) transient."""
     b, h, s_q, d = qf.shape
     scale = 1.0 / math.sqrt(d)
     nb = kp.shape[2] // block
@@ -615,7 +618,7 @@ def _grads_rect(qf, kp, vp, gf, delta, lse, q_off, s_k_valid, causal, block):
         kblk, vblk, j = inp
         kf = kblk.astype(jnp.float32)
         scores = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
-        k_pos = j * block + jnp.arange(block)
+        k_pos = k_off + j * block + jnp.arange(block)
         mask = _bwd_mask(q_pos, k_pos, s_k_valid, causal)
         p = jnp.where(mask, jnp.exp(scores - lse[..., None]), 0.0)
         dv_j = jnp.einsum("bhqk,bhqd->bhkd", p, gf)

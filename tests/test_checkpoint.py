@@ -130,3 +130,40 @@ def test_resume_accepts_longer_schedule(rng, tmp_path):
     est4 = dataclasses.replace(est2, num_iter=4)
     resumed = resumable_fit(est4, a, y, checkpoint_dir=ck, every=2)
     _assert_models_close(resumed, est4.fit(a, y))
+
+
+def test_legacy_meta_key_defaults_on_resume(tmp_path):
+    """A sidecar written before a meta key existed must resume when the
+    current run uses that key's historical default (legacy_defaults), and
+    still reject when it doesn't."""
+    import json
+    import pathlib
+
+    import jax
+    import numpy as np
+
+    from keystone_tpu.models import lm_transformer as lm
+
+    corpus = lm.synthetic_corpus(3_000, 31, seed=5)
+    ckdir = tmp_path / "legacy_ck"
+    kw = dict(steps=2, batch=4, seq=16, lr=1e-3, seed=5)
+
+    def fresh():
+        return lm.TransformerLM.create(
+            jax.random.key(5), vocab=31, max_seq=32, dim=32, depth=2,
+            num_heads=2,
+        )
+
+    lm.train(fresh(), corpus, **kw, checkpoint_dir=str(ckdir))
+    # simulate a pre-pos_encoding sidecar
+    meta_path = pathlib.Path(ckdir) / "train_meta.json"
+    meta = json.loads(meta_path.read_text())
+    del meta["pos_encoding"]
+    meta_path.write_text(json.dumps(meta))
+
+    # resume with the historical default: accepted
+    model, losses = lm.train(
+        fresh(), corpus, **{**kw, "steps": 3}, checkpoint_dir=str(ckdir)
+    )
+    assert len(losses) == 1
+    assert np.isfinite(losses).all()
