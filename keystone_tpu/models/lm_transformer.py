@@ -490,18 +490,52 @@ def decode_step(model: TransformerLM, token, cache: KVCache):
     return logits, KVCache(k=new_k, v=new_v, pos=pos + 1)
 
 
-@functools.partial(jax.jit, static_argnames=("max_new", "temperature"))
+def _filter_logits(logits, top_k: int, top_p: float):
+    """Top-k then nucleus filtering on (B, V) logits (already temperature
+    -scaled — the nucleus mass is meaningful only on the distribution
+    actually sampled): everything outside the keep-set drops to -inf.
+    Static-shape throughout, one descending sort shared by both filters.
+    """
+    v = logits.shape[-1]
+    sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
+    if top_k:
+        kth = sorted_l[:, top_k - 1][:, None]
+        logits = jnp.where(logits >= kth, logits, -jnp.inf)
+        # the nucleus below must see the top-k-filtered distribution
+        sorted_l = jnp.where(
+            jnp.arange(v)[None, :] < top_k, sorted_l, -jnp.inf
+        )
+    if top_p:
+        probs = jax.nn.softmax(sorted_l, axis=-1)
+        # exclusive cumulative mass BEFORE each token: a token stays while
+        # the mass above it is < top_p (the first token always stays)
+        csum = jnp.cumsum(probs, axis=-1) - probs
+        keep = csum < top_p
+        # smallest kept logit per row = the threshold
+        thresh = jnp.min(
+            jnp.where(keep, sorted_l, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits >= thresh, logits, -jnp.inf)
+    return logits
+
+
+@functools.partial(
+    jax.jit, static_argnames=("max_new", "temperature", "top_k", "top_p")
+)
 def generate(
     model: TransformerLM,
     prompt,
     *,
     max_new: int,
     temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 0.0,
     key=None,
 ):
     """Greedy (temperature=0) or sampled decode of ``max_new`` tokens after
     ``prompt`` (B, P). One jitted program: prefill + lax.scan over steps.
-    Returns (B, max_new) int32 tokens."""
+    ``top_k``/``top_p`` (nucleus) restrict sampling to the head of the
+    distribution (0 = off; both compose). Returns (B, max_new) int32."""
     if key is None:
         key = jax.random.key(0)
     s_max = prompt.shape[1] + max_new
@@ -514,7 +548,10 @@ def generate(
     def pick(logits, k):
         if temperature == 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(k, logits / temperature).astype(jnp.int32)
+        # temperature FIRST: the nucleus cut must measure mass on the
+        # distribution being sampled, not the unscaled one
+        logits = _filter_logits(logits / temperature, top_k, top_p)
+        return jax.random.categorical(k, logits).astype(jnp.int32)
 
     keys = jax.random.split(key, max_new)
     tok0 = pick(logits0, keys[0])

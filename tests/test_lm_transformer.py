@@ -270,3 +270,28 @@ def test_sequence_parallel_training_decreases_loss(mesh8, seq_mode):
     )
     assert np.isfinite(losses).all()
     assert np.mean(losses[-5:]) < 0.8 * losses[0], (losses[0], losses[-5:])
+
+
+def test_topk_topp_sampling():
+    model = _tiny()
+    prompt = jnp.asarray([[1, 2, 3]])
+    greedy = lm.generate(model, prompt, max_new=8)
+    # top_k=1 at any temperature IS greedy
+    k1 = lm.generate(
+        model, prompt, max_new=8, temperature=1.0, top_k=1,
+        key=jax.random.key(9),
+    )
+    np.testing.assert_array_equal(np.asarray(k1), np.asarray(greedy))
+    # tiny nucleus keeps only the argmax token
+    p_small = lm.generate(
+        model, prompt, max_new=8, temperature=1.0, top_p=1e-6,
+        key=jax.random.key(9),
+    )
+    np.testing.assert_array_equal(np.asarray(p_small), np.asarray(greedy))
+    # permissive settings still emit valid tokens
+    free = lm.generate(
+        model, prompt, max_new=8, temperature=1.2, top_k=10, top_p=0.9,
+        key=jax.random.key(3),
+    )
+    arr = np.asarray(free)
+    assert arr.shape == (1, 8) and arr.min() >= 0 and arr.max() < 31

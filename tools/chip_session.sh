@@ -12,13 +12,14 @@ cd "$DIR"
 log() { echo "=== $(date -u +%FT%TZ) $*"; }
 
 log "1/4 bench.py"
-timeout 1800 python bench.py || log "bench.py FAILED ($?)"
+timeout 2700 python bench.py || log "bench.py FAILED ($?)"
 
 log "2/4 mfu_sweep"
 timeout 1800 python tools/mfu_sweep.py || log "mfu_sweep FAILED ($?)"
 
-log "3/4 tpu_validate"
-timeout 2400 python tools/tpu_validate.py || log "tpu_validate FAILED ($?)"
+log "3/4 tpu_validate (incl. 32k long-context fwd + train probes)"
+TPU_VALIDATE_LONG=1 timeout 3600 python tools/tpu_validate.py \
+  || log "tpu_validate FAILED ($?)"
 
 log "4/4 imagenet scale (reduced 20k warmup, then full 100k)"
 timeout 3600 python tools/imagenet_scale_run.py \
