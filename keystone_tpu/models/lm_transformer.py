@@ -575,10 +575,8 @@ def next_token_loss(model: TransformerLM, tokens) -> jnp.ndarray:
     (the model runs on the first S tokens of an S+1 window), plus the
     weighted MoE load-balance auxiliary when the model routes."""
     logits, aux = model.forward_with_aux(tokens[:, :-1])
-    targets = tokens[:, 1:]
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(logz - gold) + model.moe_aux_weight * aux
+    ce = token_cross_entropy(logits, tokens[:, 1:])
+    return ce + model.moe_aux_weight * aux
 
 
 def make_train_step(optimizer):
@@ -596,6 +594,15 @@ def make_train_step(optimizer):
         return model, opt_state, loss
 
     return step
+
+
+def token_cross_entropy(logits, targets) -> jnp.ndarray:
+    """Mean next-token cross-entropy. logits: (B, S, V) f32; targets:
+    (B, S) int. The single source of the numerically sensitive
+    ``logsumexp - gold`` form, shared by training loss and evaluation."""
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)
 
 
 def _step_batch(corpus, seed: int, i: int, batch: int, seq: int):
@@ -638,6 +645,12 @@ def train(
 
     from keystone_tpu.parallel.mesh import data_sharding
 
+    if len(corpus) < seq + 2:
+        raise ValueError(
+            f"corpus of {len(corpus)} tokens is too short for seq={seq} "
+            f"(needs at least seq+2 = {seq + 2}); shorten --seq or grow "
+            "the corpus"
+        )
     optimizer = optax.adamw(lr, weight_decay=0.01)
     opt_state = optimizer.init(model)
     step = make_train_step(optimizer)
@@ -793,6 +806,11 @@ class LMConfig:
     pos_encoding: str = arg(
         default="learned", help="position encoding: learned | rope"
     )
+    corpus: str = arg(
+        default="",
+        help="path to a text file/dir (byte-level tokens, vocab forced to "
+        "256, 10%% held out for perplexity); default: synthetic Markov",
+    )
     checkpoint_dir: str = arg(
         default="",
         help="orbax checkpoint/resume directory (preemption-safe training)",
@@ -807,6 +825,12 @@ def run(conf: LMConfig, mesh=None) -> dict:
 
     if mesh is None and len(jax.devices()) > 1:
         mesh = create_mesh()
+    valid = None
+    if conf.corpus:
+        from keystone_tpu.loaders.text import BYTE_VOCAB, load_text_corpus
+
+        corpus, valid = load_text_corpus(conf.corpus)
+        conf = dataclasses.replace(conf, vocab=BYTE_VOCAB)
     key = jax.random.key(conf.seed)
     model = TransformerLM.create(
         key,
@@ -823,7 +847,8 @@ def run(conf: LMConfig, mesh=None) -> dict:
         pos_encoding=conf.pos_encoding,
     )
     model = shard_params(model, mesh)
-    corpus = synthetic_corpus(200_000, conf.vocab, seed=conf.seed)
+    if not conf.corpus:
+        corpus = synthetic_corpus(200_000, conf.vocab, seed=conf.seed)
     t0 = time.time()
     model, losses = train(
         model,
@@ -851,6 +876,26 @@ def run(conf: LMConfig, mesh=None) -> dict:
         "tokens_per_s": steps_ran * conf.batch * conf.seq / dt,
         "wall_s": dt,
     }
+    if valid is not None:
+        if len(valid) >= conf.seq + 1:
+            from keystone_tpu.evaluation.perplexity import (
+                evaluate_perplexity,
+            )
+
+            ev = evaluate_perplexity(
+                model, valid, seq=conf.seq, batch=conf.batch
+            )
+            res["valid_loss"] = ev["loss"]
+            res["valid_bits_per_token"] = ev["bits_per_token"]
+            res["valid_perplexity"] = ev["perplexity"]
+        else:
+            logger.warning(
+                "held-out tail (%d tokens) is shorter than one seq+1=%d "
+                "window — skipping the perplexity evaluation the corpus "
+                "flag promises; shorten --seq or grow the corpus",
+                len(valid),
+                conf.seq + 1,
+            )
     logger.info(
         "lm: %d params, loss %.3f -> %.3f, %.0f tokens/s",
         res["params"],
