@@ -100,6 +100,17 @@ def _block_apply(x, blk: LMBlock, cdt, attn, moe=None):
     return x + jax.nn.gelu(hdn) @ blk.w2.astype(cdt), aux, jnp.float32(0)
 
 
+def _embed(model, tokens, cdt):
+    """Token embedding + optional learned positions, cast to the compute
+    dtype — the one preamble shared by training forward, prefill, and the
+    pipeline-parallel forward."""
+    d = model.embed.shape[-1]
+    x = model.embed[tokens] * math.sqrt(d)
+    if model.pos_encoding == "learned":
+        x = x + model.pos_embed[: tokens.shape[1]]
+    return x.astype(cdt)
+
+
 def _tied_logits(x, embed, cdt):
     # bf16 operands, f32 accumulate/output: the logits feed a logsumexp —
     # bf16 logits would cost real perplexity precision
@@ -198,11 +209,7 @@ class TransformerLM:
     def forward_with_aux(self, tokens):
         """(logits (B, S, V) f32, total MoE load-balance aux loss)."""
         cdt = jnp.dtype(self.compute_dtype)
-        d = self.embed.shape[-1]
-        x = self.embed[tokens] * math.sqrt(d)
-        if self.pos_encoding == "learned":
-            x = x + self.pos_embed[: tokens.shape[1]]
-        x = x.astype(cdt)
+        x = _embed(self, tokens, cdt)
 
         def block_fn(x, blk, moe):
             out, _, moe_aux = _block_apply(
@@ -397,12 +404,8 @@ def prefill(model: TransformerLM, tokens, s_max: int):
     if model.seq_mode != "local":
         raise ValueError("prefill/decode require seq_mode='local'")
     cdt = jnp.dtype(model.compute_dtype)
-    d = model.embed.shape[-1]
     n, s = tokens.shape
-    x = model.embed[tokens] * math.sqrt(d)
-    if model.pos_encoding == "learned":
-        x = x + model.pos_embed[:s]
-    x = x.astype(cdt)
+    x = _embed(model, tokens, cdt)
 
     ks, vs = [], []
     for i, blk in enumerate(model.blocks):
@@ -577,6 +580,107 @@ def next_token_loss(model: TransformerLM, tokens) -> jnp.ndarray:
     logits, aux = model.forward_with_aux(tokens[:, :-1])
     ce = token_cross_entropy(logits, tokens[:, 1:])
     return ce + model.moe_aux_weight * aux
+
+
+def pp_forward(model: TransformerLM, tokens, mesh, *, n_micro: int,
+               axis: str = "model"):
+    """Pipeline-parallel forward: the block chain runs as GPipe stages
+    over the mesh ``axis`` (one group of ``depth/n_stages`` blocks per
+    device, microbatches streamed via ppermute —
+    :func:`keystone_tpu.parallel.pipeline_parallel.gpipe`), embedding and
+    tied logits replicated outside the pipe. Completes the LM's
+    parallelism matrix (dp × tp × sp × ep × pp). Dense blocks only (MoE
+    routing wants the expert axis, not the stage axis); parameters stay
+    replicated in HBM — pp here parallelizes compute, the memory story
+    is remat + the other axes.
+    """
+    if any(m is not None for m in model.moe_layers):
+        raise ValueError(
+            "pipeline-parallel path supports dense blocks only (route "
+            "experts over the model axis with moe_every instead)"
+        )
+    if model.seq_mode != "local":
+        raise ValueError(
+            "pipeline-parallel path requires seq_mode='local': the "
+            f"{model.seq_mode!r} attention opens its own shard_map, which "
+            "cannot nest inside the pipeline's"
+        )
+    n_stages = mesh.shape[axis]
+    depth = len(model.blocks)
+    if depth % n_stages:
+        raise ValueError(
+            f"depth {depth} not divisible by {n_stages} pipeline stages"
+        )
+    b = tokens.shape[0]
+    if b % n_micro:
+        raise ValueError(
+            f"batch {b} not divisible by n_micro={n_micro}"
+        )
+    per = depth // n_stages
+    cdt = jnp.dtype(model.compute_dtype)
+    x = _embed(model, tokens, cdt)
+    # pre-split microbatches HERE: gpipe's n_micro reshape heuristic is
+    # ambiguous when B == n_micro (it would mistake (B, S, d) for an
+    # already-microbatched (n_micro, S, d))
+    x = x.reshape(n_micro, b // n_micro, *x.shape[1:])
+
+    # stack the per-block pytrees: leading axis depth → (stages, per)
+    stacked = jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *model.blocks
+    )
+    stacked = jax.tree_util.tree_map(
+        lambda l: l.reshape(n_stages, per, *l.shape[1:]), stacked
+    )
+
+    def stage_fn(stage_params, act):
+        for j in range(per):
+            blk = jax.tree_util.tree_map(lambda l: l[j], stage_params)
+            act = _block_apply(
+                act, blk, cdt,
+                lambda y, bb: (model._attention(y, bb), None),
+            )[0]
+        return act
+
+    if model.remat:
+        stage_fn = jax.checkpoint(stage_fn)
+    from keystone_tpu.parallel.pipeline_parallel import gpipe
+
+    out = gpipe(stage_fn, stacked, x, mesh, axis=axis)
+    out = out.reshape(b, *out.shape[2:])
+    return _tied_logits(out, model.embed, cdt)
+
+
+def next_token_loss_pp(model: TransformerLM, tokens, mesh, *,
+                       n_micro: int, axis: str = "model") -> jnp.ndarray:
+    """Next-token CE through the GPipe forward (differentiable: scan,
+    ppermute, and psum all have transposes — the backward is the reverse
+    pipeline schedule, derived by AD rather than hand-scheduled)."""
+    logits = pp_forward(
+        model, tokens[:, :-1], mesh, n_micro=n_micro, axis=axis
+    )
+    return token_cross_entropy(logits, tokens[:, 1:])
+
+
+def make_pp_train_step(optimizer, mesh, *, n_micro: int,
+                       axis: str = "model"):
+    """Buffer-donated jitted pipeline-parallel train step."""
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(model, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda m, t: next_token_loss_pp(
+                m, t, mesh, n_micro=n_micro, axis=axis
+            )
+        )(model, tokens)
+        updates, opt_state = optimizer.update(
+            grads, opt_state, params=model
+        )
+        import optax
+
+        model = optax.apply_updates(model, updates)
+        return model, opt_state, loss
+
+    return step
 
 
 def make_train_step(optimizer):
