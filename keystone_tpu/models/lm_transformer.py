@@ -32,6 +32,7 @@ import time
 import jax
 import jax.numpy as jnp
 import numpy as np
+import optax
 
 from keystone_tpu.core.config import arg, parse_config
 from keystone_tpu.core.logging import get_logger
@@ -583,7 +584,7 @@ def next_token_loss(model: TransformerLM, tokens) -> jnp.ndarray:
 
 
 def pp_forward(model: TransformerLM, tokens, mesh, *, n_micro: int,
-               axis: str = "model"):
+               axis: str = "model", data_axis: str | None = None):
     """Pipeline-parallel forward: the block chain runs as GPipe stages
     over the mesh ``axis`` (one group of ``depth/n_stages`` blocks per
     device, microbatches streamed via ppermute —
@@ -645,38 +646,43 @@ def pp_forward(model: TransformerLM, tokens, mesh, *, n_micro: int,
         stage_fn = jax.checkpoint(stage_fn)
     from keystone_tpu.parallel.pipeline_parallel import gpipe
 
-    out = gpipe(stage_fn, stacked, x, mesh, axis=axis)
+    out = gpipe(stage_fn, stacked, x, mesh, axis=axis, data_axis=data_axis)
     out = out.reshape(b, *out.shape[2:])
     return _tied_logits(out, model.embed, cdt)
 
 
 def next_token_loss_pp(model: TransformerLM, tokens, mesh, *,
-                       n_micro: int, axis: str = "model") -> jnp.ndarray:
+                       n_micro: int, axis: str = "model",
+                       data_axis: str | None = None) -> jnp.ndarray:
     """Next-token CE through the GPipe forward (differentiable: scan,
     ppermute, and psum all have transposes — the backward is the reverse
     pipeline schedule, derived by AD rather than hand-scheduled)."""
     logits = pp_forward(
-        model, tokens[:, :-1], mesh, n_micro=n_micro, axis=axis
+        model, tokens[:, :-1], mesh, n_micro=n_micro, axis=axis,
+        data_axis=data_axis,
     )
     return token_cross_entropy(logits, tokens[:, 1:])
 
 
 def make_pp_train_step(optimizer, mesh, *, n_micro: int,
-                       axis: str = "model"):
-    """Buffer-donated jitted pipeline-parallel train step."""
+                       axis: str = "model",
+                       data_axis: str | None = None):
+    """Buffer-donated jitted pipeline-parallel train step. ``data_axis``
+    composes dp × pp: each data-row of devices pipelines its own batch
+    slice (grad psums across rows come from XLA's sharding propagation —
+    params are replicated over the data axis)."""
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(model, opt_state, tokens):
         loss, grads = jax.value_and_grad(
             lambda m, t: next_token_loss_pp(
-                m, t, mesh, n_micro=n_micro, axis=axis
+                m, t, mesh, n_micro=n_micro, axis=axis,
+                data_axis=data_axis,
             )
         )(model, tokens)
         updates, opt_state = optimizer.update(
             grads, opt_state, params=model
         )
-        import optax
-
         model = optax.apply_updates(model, updates)
         return model, opt_state, loss
 
@@ -692,8 +698,6 @@ def make_train_step(optimizer):
         updates, opt_state = optimizer.update(
             grads, opt_state, params=model
         )
-        import optax
-
         model = optax.apply_updates(model, updates)
         return model, opt_state, loss
 
@@ -745,8 +749,6 @@ def train(
     RNG state (the LM analog of the solvers' ``resumable_fit``). ``losses``
     covers only the steps this invocation ran.
     """
-    import optax
-
     from keystone_tpu.parallel.mesh import data_sharding
 
     if len(corpus) < seq + 2:

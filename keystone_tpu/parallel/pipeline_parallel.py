@@ -81,6 +81,7 @@ def gpipe(
     *,
     axis: str = "model",
     n_micro: int | None = None,
+    data_axis: str | None = None,
 ):
     """Apply a pipeline of stages to microbatched input.
 
@@ -91,8 +92,13 @@ def gpipe(
     ``x`` — (n_micro, B, ...) microbatches, or (N, ...) with ``n_micro``
     given to split the batch evenly.
 
-    Returns the chain output with the microbatch structure of ``x``,
-    replicated across the mesh.
+    ``data_axis`` composes dp × pp: the per-microbatch batch dim (axis 1)
+    is sharded over it, so each data-row of devices pipelines its own
+    batch slice instead of replicating the whole batch (None = replicate,
+    the single-row behavior).
+
+    Returns the chain output with the microbatch structure of ``x``
+    (sharded over ``data_axis`` when given, else replicated).
     """
     n_stages = mesh.shape[axis]
     reshaped = False
@@ -112,7 +118,15 @@ def gpipe(
                 f"axis {axis!r} has {n_stages} devices"
             )
 
+    if data_axis is not None:
+        n_data = mesh.shape[data_axis]
+        if x.ndim < 2 or x.shape[1] % n_data:
+            raise ValueError(
+                f"microbatch batch dim {x.shape[1] if x.ndim > 1 else None}"
+                f" not divisible by data axis {data_axis!r} ({n_data})"
+            )
     pspec = P(axis)
+    xspec = P(None, data_axis) if data_axis is not None else P()
     fn = jax.shard_map(
         partial(
             _pipeline_shard,
@@ -123,9 +137,9 @@ def gpipe(
         mesh=mesh,
         in_specs=(
             jax.tree_util.tree_map(lambda _: pspec, stacked_params),
-            P(),
+            xspec,
         ),
-        out_specs=P(),
+        out_specs=xspec,
     )
     out = fn(stacked_params, x)
     if reshaped:
