@@ -722,6 +722,39 @@ def _step_batch(corpus, seed: int, i: int, batch: int, seq: int):
     return np.stack([corpus[s : s + seq + 1] for s in starts])
 
 
+def make_optimizer(
+    lr: float,
+    *,
+    steps: int = 0,
+    schedule: str = "constant",
+    warmup_frac: float = 0.05,
+    grad_clip: float = 0.0,
+    weight_decay: float = 0.01,
+):
+    """The LM training optimizer: AdamW, optionally behind global-norm
+    gradient clipping, with a constant or warmup-cosine learning rate.
+    ``schedule="cosine"`` warms up over ``warmup_frac`` of ``steps`` and
+    decays to lr/10 — the standard LM recipe."""
+    if schedule not in ("constant", "cosine"):
+        raise ValueError(
+            f"schedule={schedule!r}; expected constant|cosine"
+        )
+    if schedule == "cosine":
+        if steps <= 0:
+            raise ValueError("schedule='cosine' needs the total steps")
+        lr = optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=lr,
+            warmup_steps=max(1, int(steps * warmup_frac)),
+            decay_steps=steps,
+            end_value=lr / 10.0,
+        )
+    opt = optax.adamw(lr, weight_decay=weight_decay)
+    if grad_clip > 0.0:
+        opt = optax.chain(optax.clip_by_global_norm(grad_clip), opt)
+    return opt
+
+
 def train(
     model: TransformerLM,
     corpus: np.ndarray,
@@ -735,6 +768,8 @@ def train(
     log_every: int = 0,
     checkpoint_dir: str = "",
     checkpoint_every: int = 0,
+    schedule: str = "constant",
+    grad_clip: float = 0.0,
 ):
     """Train on random windows of ``corpus`` (1-D int array). Returns
     (model, losses). Batches are dp-sharded over the mesh ``data`` axis
@@ -747,7 +782,10 @@ def train(
     resumes from the last completed step on the *identical* trajectory —
     batches are derived per-step from ``(seed, i)``, not from sequential
     RNG state (the LM analog of the solvers' ``resumable_fit``). ``losses``
-    covers only the steps this invocation ran.
+    covers only the steps this invocation ran. Note: ``schedule="cosine"``
+    derives its decay horizon from THIS invocation's ``steps`` — resuming
+    with a longer schedule is allowed (steps are not run identity) but
+    stretches the cosine rather than replaying the original horizon.
     """
     from keystone_tpu.parallel.mesh import data_sharding
 
@@ -757,7 +795,9 @@ def train(
             f"(needs at least seq+2 = {seq + 2}); shorten --seq or grow "
             "the corpus"
         )
-    optimizer = optax.adamw(lr, weight_decay=0.01)
+    optimizer = make_optimizer(
+        lr, steps=steps, schedule=schedule, grad_clip=grad_clip
+    )
     opt_state = optimizer.init(model)
     step = make_train_step(optimizer)
     losses = []
@@ -796,6 +836,8 @@ def train(
                 "seq": seq,
                 "lr": lr,
                 "seed": seed,
+                "schedule": schedule,
+                "grad_clip": grad_clip,
                 "num_heads": model.num_heads,
                 "seq_mode": model.seq_mode,
                 "compute_dtype": model.compute_dtype,
@@ -822,7 +864,11 @@ def train(
             # keys added after checkpoints already existed in the wild:
             # an older sidecar without them must compare as the value the
             # code used at the time, not brick the resume
-            legacy_defaults={"pos_encoding": "learned"},
+            legacy_defaults={
+                "pos_encoding": "learned",
+                "schedule": "constant",
+                "grad_clip": 0.0,
+            },
         )
     try:
         if ckpt is not None:
@@ -917,6 +963,12 @@ class LMConfig:
         help="path to a text file/dir (byte-level tokens, vocab forced to "
         "256, 10%% held out for perplexity); default: synthetic Markov",
     )
+    schedule: str = arg(
+        default="constant", help="lr schedule: constant | cosine (warmup)"
+    )
+    grad_clip: float = arg(
+        default=0.0, help="global-norm gradient clip (0 = off)"
+    )
     checkpoint_dir: str = arg(
         default="",
         help="orbax checkpoint/resume directory (preemption-safe training)",
@@ -929,6 +981,11 @@ class LMConfig:
 def run(conf: LMConfig, mesh=None) -> dict:
     from keystone_tpu.parallel.mesh import create_mesh
 
+    if conf.schedule not in ("constant", "cosine"):
+        # fail before the (possibly minutes-long) corpus load / model init
+        raise ValueError(
+            f"--schedule {conf.schedule!r}; expected constant|cosine"
+        )
     if mesh is None and len(jax.devices()) > 1:
         mesh = create_mesh()
     valid = None
@@ -968,6 +1025,8 @@ def run(conf: LMConfig, mesh=None) -> dict:
         log_every=max(conf.steps // 5, 1),
         checkpoint_dir=conf.checkpoint_dir,
         checkpoint_every=conf.checkpoint_every,
+        schedule=conf.schedule,
+        grad_clip=conf.grad_clip,
     )
     dt = time.time() - t0
     steps_ran = len(losses)

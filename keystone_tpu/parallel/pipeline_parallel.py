@@ -120,10 +120,15 @@ def gpipe(
 
     if data_axis is not None:
         n_data = mesh.shape[data_axis]
-        if x.ndim < 2 or x.shape[1] % n_data:
+        if x.ndim < 2:
             raise ValueError(
-                f"microbatch batch dim {x.shape[1] if x.ndim > 1 else None}"
-                f" not divisible by data axis {data_axis!r} ({n_data})"
+                f"data_axis={data_axis!r} needs microbatches with a batch "
+                f"dim to shard — got rank-{x.ndim} input"
+            )
+        if x.shape[1] % n_data:
+            raise ValueError(
+                f"microbatch batch dim {x.shape[1]} not divisible by "
+                f"data axis {data_axis!r} ({n_data})"
             )
     pspec = P(axis)
     xspec = P(None, data_axis) if data_axis is not None else P()

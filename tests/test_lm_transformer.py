@@ -462,3 +462,27 @@ def test_pp_dp_composed_shards_batch(mesh4x2):
         jax.tree_util.tree_leaves(m_pp), jax.tree_util.tree_leaves(m_ref)
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+
+
+def test_cosine_schedule_and_grad_clip():
+    """Warmup-cosine + clipping trains (and the optimizer factory rejects
+    bad configs loudly)."""
+    corpus = lm.synthetic_corpus(20_000, 31, seed=1)
+    model, losses = lm.train(
+        _tiny(), corpus, steps=40, batch=8, seq=32, lr=3e-3, seed=1,
+        schedule="cosine", grad_clip=1.0,
+    )
+    assert np.mean(losses[-5:]) < 0.8 * losses[0]
+    with pytest.raises(ValueError, match="constant|cosine"):
+        lm.make_optimizer(1e-3, schedule="linear")
+    with pytest.raises(ValueError, match="total steps"):
+        lm.make_optimizer(1e-3, schedule="cosine")
+    # resume identity: schedule/grad_clip are part of the run meta
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        lm.train(_tiny(), corpus, steps=2, batch=4, seq=16, seed=1,
+                 schedule="cosine", checkpoint_dir=d)
+        with pytest.raises(ValueError, match="different training run"):
+            lm.train(_tiny(), corpus, steps=4, batch=4, seq=16, seed=1,
+                     schedule="constant", checkpoint_dir=d)
