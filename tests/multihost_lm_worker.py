@@ -1,0 +1,77 @@
+"""Worker for the 2-process multi-host LM training parity test.
+
+Same SPMD-program-per-process shape as ``multihost_worker.py``, but for
+the flagship trainer: each process contributes its local half of every
+dp batch via ``global_batch_from_local``, the buffer-donated train step's
+gradient psums cross the process boundary, and the final (replicated)
+params must equal a single-process run on the same batches.
+
+Usage: python multihost_lm_worker.py <process_id> <num_processes> <port> <out>
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+STEPS, BATCH, SEQ = 3, 8, 32
+
+
+def main() -> None:
+    pid, nprocs, port, out_path = (
+        int(sys.argv[1]),
+        int(sys.argv[2]),
+        sys.argv[3],
+        sys.argv[4],
+    )
+    import numpy as np
+    import optax
+
+    from keystone_tpu.models import lm_transformer as lm
+    from keystone_tpu.parallel import multihost
+    from keystone_tpu.parallel.mesh import create_mesh
+
+    multihost.initialize(
+        coordinator_address=f"localhost:{port}",
+        num_processes=nprocs,
+        process_id=pid,
+    )
+    mesh = create_mesh(data=jax.device_count())
+
+    model = lm.TransformerLM.create(
+        jax.random.key(0), vocab=31, max_seq=SEQ, dim=32, depth=2,
+        num_heads=2,
+    )
+    optimizer = optax.adamw(1e-3)
+    opt_state = optimizer.init(model)
+    step = lm.make_train_step(optimizer)
+
+    corpus = lm.synthetic_corpus(20_000, 31, seed=0)
+    losses = []
+    lo, hi = pid * BATCH // nprocs, (pid + 1) * BATCH // nprocs
+    for i in range(STEPS):
+        toks = lm._step_batch(corpus, 0, i, BATCH, SEQ)
+        g_toks = multihost.global_batch_from_local(
+            np.ascontiguousarray(toks[lo:hi]), mesh
+        )
+        assert g_toks.shape == (BATCH, SEQ + 1), g_toks.shape
+        model, opt_state, loss = step(model, opt_state, g_toks)
+        losses.append(float(loss))
+
+    if pid == 0:
+        np.savez(
+            out_path,
+            losses=np.asarray(losses, np.float64),
+            wq=np.asarray(model.blocks[0].wq),
+            embed=np.asarray(model.embed),
+        )
+    print(f"worker {pid}: ok", flush=True)
+
+
+if __name__ == "__main__":
+    main()

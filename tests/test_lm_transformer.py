@@ -464,7 +464,7 @@ def test_pp_dp_composed_shards_batch(mesh4x2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
 
 
-def test_cosine_schedule_and_grad_clip():
+def test_cosine_schedule_and_grad_clip(tmp_path):
     """Warmup-cosine + clipping trains (and the optimizer factory rejects
     bad configs loudly)."""
     corpus = lm.synthetic_corpus(20_000, 31, seed=1)
@@ -478,11 +478,9 @@ def test_cosine_schedule_and_grad_clip():
     with pytest.raises(ValueError, match="total steps"):
         lm.make_optimizer(1e-3, schedule="cosine")
     # resume identity: schedule/grad_clip are part of the run meta
-    import tempfile
-
-    with tempfile.TemporaryDirectory() as d:
-        lm.train(_tiny(), corpus, steps=2, batch=4, seq=16, seed=1,
-                 schedule="cosine", checkpoint_dir=d)
-        with pytest.raises(ValueError, match="different training run"):
-            lm.train(_tiny(), corpus, steps=4, batch=4, seq=16, seed=1,
-                     schedule="constant", checkpoint_dir=d)
+    d = str(tmp_path / "sched_ck")
+    lm.train(_tiny(), corpus, steps=2, batch=4, seq=16, seed=1,
+             schedule="cosine", checkpoint_dir=d)
+    with pytest.raises(ValueError, match="different training run"):
+        lm.train(_tiny(), corpus, steps=4, batch=4, seq=16, seed=1,
+                 schedule="constant", checkpoint_dir=d)
