@@ -42,6 +42,7 @@ from keystone_tpu.ops.attention import (
     ring_attention,
     ulysses_attention,
 )
+from keystone_tpu.ops.quantization import QTensor, mm, quantize_int8
 from keystone_tpu.ops.vit import _layer_norm
 
 logger = get_logger("keystone_tpu.models.lm_transformer")
@@ -66,7 +67,7 @@ def _ln(x, cdt):
 def _split_heads(y, w, h):
     n, s, d = y.shape
     return (
-        (y @ w.astype(y.dtype)).reshape(n, s, h, d // h).transpose(0, 2, 1, 3)
+        mm(y, w, y.dtype).reshape(n, s, h, d // h).transpose(0, 2, 1, 3)
     )
 
 
@@ -97,8 +98,16 @@ def _block_apply(x, blk: LMBlock, cdt, attn, moe=None):
     if moe is not None:
         f, moe_aux = moe(y)
         return x + f, aux, moe_aux
-    hdn = y @ blk.w1.astype(cdt)
-    return x + jax.nn.gelu(hdn) @ blk.w2.astype(cdt), aux, jnp.float32(0)
+    hdn = mm(y, blk.w1, cdt)
+    return x + mm(jax.nn.gelu(hdn), blk.w2, cdt), aux, jnp.float32(0)
+
+
+def _gather_embed(embed, tokens):
+    """Embedding-row gather handling the int8 row-quantized table (the
+    per-token scales apply to the gathered rows)."""
+    if isinstance(embed, QTensor):
+        return embed.q[tokens].astype(jnp.float32) * embed.scale[tokens]
+    return embed[tokens]
 
 
 def _embed(model, tokens, cdt):
@@ -106,7 +115,7 @@ def _embed(model, tokens, cdt):
     dtype — the one preamble shared by training forward, prefill, and the
     pipeline-parallel forward."""
     d = model.embed.shape[-1]
-    x = model.embed[tokens] * math.sqrt(d)
+    x = _gather_embed(model.embed, tokens) * math.sqrt(d)
     if model.pos_encoding == "learned":
         x = x + model.pos_embed[: tokens.shape[1]]
     return x.astype(cdt)
@@ -115,6 +124,12 @@ def _embed(model, tokens, cdt):
 def _tied_logits(x, embed, cdt):
     # bf16 operands, f32 accumulate/output: the logits feed a logsumexp —
     # bf16 logits would cost real perplexity precision
+    if isinstance(embed, QTensor):
+        # (V, 1) row scales become per-output-channel under the transpose
+        return jnp.matmul(
+            _ln(x, cdt), embed.q.T.astype(cdt),
+            preferred_element_type=jnp.float32,
+        ) * embed.scale[:, 0]
     return jnp.matmul(
         _ln(x, cdt), embed.T.astype(cdt), preferred_element_type=jnp.float32
     )
@@ -193,9 +208,11 @@ class TransformerLM:
                 out = flash_attention_trainable(q, k, v, True)
             else:
                 out = dense_attention(q, k, v, causal=True)
-        proj = out.transpose(0, 2, 1, 3).reshape(n, s, d).astype(
-            x.dtype
-        ) @ blk.wo.astype(x.dtype)
+        proj = mm(
+            out.transpose(0, 2, 1, 3).reshape(n, s, d).astype(x.dtype),
+            blk.wo,
+            x.dtype,
+        )
         if return_kv:
             return proj, (k, v)
         return proj
@@ -438,7 +455,7 @@ def decode_step(model: TransformerLM, token, cache: KVCache):
     hd = d // h
     n = token.shape[0]
     pos = cache.pos
-    x = model.embed[token][:, None] * math.sqrt(d)
+    x = _gather_embed(model.embed, token)[:, None] * math.sqrt(d)
     if model.pos_encoding == "learned":
         x = x + jax.lax.dynamic_slice_in_dim(model.pos_embed, pos, 1)
     x = x.astype(cdt)
@@ -477,9 +494,11 @@ def decode_step(model: TransformerLM, token, cache: KVCache):
                 probs.astype(cdt), layer_v.astype(cdt),
                 preferred_element_type=jnp.float32,
             )
-            proj = out.transpose(0, 2, 1, 3).reshape(n, 1, d).astype(
-                cdt
-            ) @ blk.wo.astype(cdt)
+            proj = mm(
+                out.transpose(0, 2, 1, 3).reshape(n, 1, d).astype(cdt),
+                blk.wo,
+                cdt,
+            )
             return proj, None
 
         return attn
@@ -581,6 +600,42 @@ def next_token_loss(model: TransformerLM, tokens) -> jnp.ndarray:
     logits, aux = model.forward_with_aux(tokens[:, :-1])
     ce = token_cross_entropy(logits, tokens[:, 1:])
     return ce + model.moe_aux_weight * aux
+
+
+def quantize_for_decode(model: TransformerLM) -> TransformerLM:
+    """Weight-only int8 quantization for serving: every block matrix gets
+    symmetric per-output-channel int8 (``ops/quantization.py``), the tied
+    embedding per-row scales (serving both the gather and the logit
+    transpose). Decode is HBM-bound — every step re-reads all params — so
+    halving the weight stream is the decode-rate lever on TPU. Inference
+    only: ``train`` rejects quantized models (gradients through rounding
+    are silently zero). MoE experts and pos_embed stay full precision
+    (experts want per-(expert, channel) scales; the table is tiny)."""
+
+    def qmat(w):
+        return quantize_int8(w) if w.size else w
+
+    blocks = tuple(
+        LMBlock(
+            wq=qmat(b.wq), wk=qmat(b.wk), wv=qmat(b.wv), wo=qmat(b.wo),
+            w1=qmat(b.w1), w2=qmat(b.w2),
+        )
+        for b in model.blocks
+    )
+    return dataclasses.replace(
+        model,
+        embed=quantize_int8(model.embed, channel_axis=0),
+        blocks=blocks,
+    )
+
+
+def _has_quantized_leaves(model) -> bool:
+    return any(
+        isinstance(l, QTensor)
+        for l in jax.tree_util.tree_leaves(
+            model, is_leaf=lambda x: isinstance(x, QTensor)
+        )
+    )
 
 
 def pp_forward(model: TransformerLM, tokens, mesh, *, n_micro: int,
@@ -794,6 +849,12 @@ def train(
             f"corpus of {len(corpus)} tokens is too short for seq={seq} "
             f"(needs at least seq+2 = {seq + 2}); shorten --seq or grow "
             "the corpus"
+        )
+    if _has_quantized_leaves(model):
+        raise ValueError(
+            "model holds int8 QTensor weights (quantize_for_decode is "
+            "inference-only) — gradients through the rounding would be "
+            "silently zero; train the float model and re-quantize"
         )
     optimizer = make_optimizer(
         lr, steps=steps, schedule=schedule, grad_clip=grad_clip

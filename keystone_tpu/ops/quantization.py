@@ -1,0 +1,74 @@
+"""Weight-only int8 quantization for inference.
+
+Decode is the HBM-bound regime (ROOFLINE.md §6, decode note: every step
+re-reads all params), so the serving lever on TPU is weight bytes, not
+FLOPs: int8 weights halve the bf16 stream. Symmetric per-output-channel
+scales keep the matmul exact up to rounding, applied to the
+activation-sized result (``(y @ q) * scale``).
+
+The int8→compute-dtype convert is written as ``q.astype`` feeding the
+dot; whether the weight stream actually halves rests on XLA fusing that
+convert into the dot's operand load (the usual TPU lowering). That is a
+compiler property, not a code guarantee — which is why the bench records
+the measured int8-vs-float decode rates side by side
+(``lm_decode[_int8]_tokens_per_s``) rather than asserting the ratio.
+
+The reference has no quantization (it serves f64 BLAS models); this is a
+beyond-reference serving capability in the spirit of the KV-cache
+decode path.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.core.treenode import treenode
+
+
+@treenode
+class QTensor:
+    """Symmetric int8 tensor: ``q * scale`` reconstructs the original.
+    ``scale`` is broadcast-shaped against the reconstruction — (1, out)
+    for (in, out) matmul weights, (V, 1) for row-quantized embeddings."""
+
+    q: jnp.ndarray  # int8, original shape
+    scale: jnp.ndarray  # f32, broadcastable to q's shape
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    def dequantize(self):
+        return self.q.astype(jnp.float32) * self.scale
+
+
+def quantize_int8(w, *, channel_axis: int = -1) -> QTensor:
+    """Per-channel symmetric quantization: scales are max|w|/127 along
+    every axis EXCEPT ``channel_axis`` (the one that stays per-channel).
+    channel_axis=-1 suits (in, out) weights; 0 suits (V, d) embeddings
+    (per-row, so both the gather and the tied-logit transpose see a
+    per-output scale)."""
+    w = jnp.asarray(w, jnp.float32)
+    channel_axis = channel_axis % w.ndim
+    reduce_axes = tuple(a for a in range(w.ndim) if a != channel_axis)
+    amax = jnp.max(jnp.abs(w), axis=reduce_axes, keepdims=True)
+    scale = jnp.maximum(amax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return QTensor(q=q, scale=scale)
+
+
+def mm(y, w, dt):
+    """``y @ w`` where ``w`` is a plain array or a :class:`QTensor` with
+    per-output-channel (1, out) scales. The int8 path scales the
+    activation-sized result; the convert-into-dot is left to XLA fusion
+    (see module docstring)."""
+    if isinstance(w, QTensor):
+        return (y @ w.q.astype(dt)) * w.scale.astype(dt)
+    return y @ w.astype(dt)
+
+
+def quantization_error(w) -> float:
+    """Max abs reconstruction error of quantizing ``w`` (diagnostics)."""
+    qt = quantize_int8(np.asarray(w))
+    return float(np.max(np.abs(np.asarray(qt.dequantize()) - w)))
