@@ -1,0 +1,87 @@
+"""Worker for the 2-process multi-host checkpoint/resume test.
+
+Phase "crash": both processes train the LM 2 steps (global dp batches
+assembled from process-local halves) with a shared checkpoint_dir, then
+exit — the simulated preemption. Phase "resume": the same SPMD program
+asks for 4 steps against the same dir — TrainCheckpointer must restore
+step 2 on every process (a coordinated orbax restore of the replicated
+global arrays) and finish; process 0 writes the final params for the
+parity check against an uninterrupted single-process 4-step run.
+
+Usage: python multihost_ckpt_worker.py <pid> <nprocs> <port> <out> <ckdir> <phase>
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+BATCH, SEQ = 8, 32
+
+
+def main() -> None:
+    pid, nprocs, port, out_path, ckdir, phase = (
+        int(sys.argv[1]),
+        int(sys.argv[2]),
+        sys.argv[3],
+        sys.argv[4],
+        sys.argv[5],
+        sys.argv[6],
+    )
+    import numpy as np
+    import optax
+
+    from keystone_tpu.core.checkpoint import TrainCheckpointer
+    from keystone_tpu.models import lm_transformer as lm
+    from keystone_tpu.parallel import multihost
+    from keystone_tpu.parallel.mesh import create_mesh
+
+    multihost.initialize(
+        coordinator_address=f"localhost:{port}",
+        num_processes=nprocs,
+        process_id=pid,
+    )
+    mesh = create_mesh(data=jax.device_count())
+
+    model = lm.TransformerLM.create(
+        jax.random.key(0), vocab=31, max_seq=SEQ, dim=32, depth=2,
+        num_heads=2,
+    )
+    optimizer = optax.adamw(1e-3)
+    opt_state = optimizer.init(model)
+    step = lm.make_train_step(optimizer)
+    corpus = lm.synthetic_corpus(20_000, 31, seed=0)
+    steps = 2 if phase == "crash" else 4
+
+    ckpt = TrainCheckpointer(ckdir, {"kind": "mh_lm", "batch": BATCH})
+    try:
+        (model, opt_state), start = ckpt.restore((model, opt_state))
+        if phase == "resume":
+            assert start == 2, f"resume found start={start}"
+        lo, hi = pid * BATCH // nprocs, (pid + 1) * BATCH // nprocs
+        for i in range(start, steps):
+            toks = lm._step_batch(corpus, 0, i, BATCH, SEQ)
+            g_toks = multihost.global_batch_from_local(
+                np.ascontiguousarray(toks[lo:hi]), mesh
+            )
+            model, opt_state, _ = step(model, opt_state, g_toks)
+            ckpt.save((model, opt_state), i + 1)
+    finally:
+        ckpt.close()
+
+    if pid == 0 and phase == "resume":
+        np.savez(
+            out_path,
+            wq=np.asarray(model.blocks[0].wq),
+            embed=np.asarray(model.embed),
+        )
+    print(f"worker {pid} phase {phase}: ok", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -21,7 +21,9 @@ WORKER = Path(__file__).with_name("multihost_worker.py")
 ATTN_WORKER = Path(__file__).with_name("multihost_attention_worker.py")
 
 
-def _run_workers(worker: Path, out, port, nprocs: int = 2) -> list[str]:
+def _run_workers(
+    worker: Path, out, port, nprocs: int = 2, extra: tuple = ()
+) -> list[str]:
     """Launch one SPMD worker per process, wait, return collected logs;
     asserts every worker exited 0."""
     env = dict(os.environ)
@@ -41,6 +43,7 @@ def _run_workers(worker: Path, out, port, nprocs: int = 2) -> list[str]:
                 str(nprocs),
                 str(port),
                 str(out),
+                *[str(a) for a in extra],
             ],
             env=env,
             stdout=subprocess.PIPE,
@@ -128,17 +131,10 @@ def test_two_process_ring_and_ulysses_match_dense(tmp_path, free_tcp_port):
 LM_WORKER = Path(__file__).with_name("multihost_lm_worker.py")
 
 
-def test_two_process_lm_training_matches_single_process(
-    tmp_path, free_tcp_port
-):
-    """Flagship dp training across a real process boundary: per-step
-    batches assembled from process-local halves, grad psums over gloo,
-    and the final replicated params must equal one-process training on
-    the same batches."""
-    out = tmp_path / "lm.npz"
-    logs = _run_workers(LM_WORKER, out, free_tcp_port)
-    assert out.exists(), "process 0 wrote no LM state\n" + "\n".join(logs)
-
+def _single_process_lm_reference(steps: int):
+    """The uninterrupted one-process training run both LM multihost tests
+    compare against — hyperparams must match the workers
+    (multihost_lm_worker.py / multihost_ckpt_worker.py)."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -154,13 +150,61 @@ def test_two_process_lm_training_matches_single_process(
     step = lm.make_train_step(optimizer)
     corpus = lm.synthetic_corpus(20_000, 31, seed=0)
     losses = []
-    for i in range(3):
+    for i in range(steps):
         toks = jnp.asarray(lm._step_batch(corpus, 0, i, 8, 32))
         model, opt_state, loss = step(model, opt_state, toks)
         losses.append(float(loss))
+    return model, losses
+
+
+def test_two_process_lm_training_matches_single_process(
+    tmp_path, free_tcp_port
+):
+    """Flagship dp training across a real process boundary: per-step
+    batches assembled from process-local halves, grad psums over gloo,
+    and the final replicated params must equal one-process training on
+    the same batches."""
+    out = tmp_path / "lm.npz"
+    logs = _run_workers(LM_WORKER, out, free_tcp_port)
+    assert out.exists(), "process 0 wrote no LM state\n" + "\n".join(logs)
+
+    import jax  # noqa: F401 — keeps the reference on the test process
+
+    model, losses = _single_process_lm_reference(3)
 
     got = np.load(out)
     np.testing.assert_allclose(got["losses"], losses, atol=1e-5)
+    np.testing.assert_allclose(
+        got["wq"], np.asarray(model.blocks[0].wq), atol=5e-5
+    )
+    np.testing.assert_allclose(
+        got["embed"], np.asarray(model.embed), atol=5e-5
+    )
+
+
+CKPT_WORKER = Path(__file__).with_name("multihost_ckpt_worker.py")
+
+
+def test_two_process_checkpoint_resume(tmp_path, free_tcp_port_factory):
+    """Preemption recovery across a real process boundary: a 2-process
+    training run checkpoints (coordinated orbax save of the replicated
+    global state), "crashes" after 2 steps, and the SPMD rerun restores
+    on every process and finishes — final params equal an uninterrupted
+    single-process run on the same batches."""
+    out = tmp_path / "lm_resumed.npz"
+    ckdir = tmp_path / "mh_ck"
+    logs = _run_workers(
+        CKPT_WORKER, out, free_tcp_port_factory(), extra=(ckdir, "crash")
+    )
+    assert not out.exists()  # crash phase writes nothing
+    logs += _run_workers(
+        CKPT_WORKER, out, free_tcp_port_factory(), extra=(ckdir, "resume")
+    )
+    assert out.exists(), "resume phase wrote no state\n" + "\n".join(logs)
+
+    model, _ = _single_process_lm_reference(4)
+
+    got = np.load(out)
     np.testing.assert_allclose(
         got["wq"], np.asarray(model.blocks[0].wq), atol=5e-5
     )
