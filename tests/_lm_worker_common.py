@@ -1,0 +1,31 @@
+"""Shared setup for the multihost LM workers and their single-process
+references — one definition of the model/optimizer/corpus hyperparams so
+the parity assertions can't drift apart across files."""
+
+BATCH, SEQ, STEPS_LM = 8, 32, 3
+LR, VOCAB, DIM, DEPTH, HEADS = 1e-3, 31, 32, 2, 2
+
+
+def build(key_seed: int = 0):
+    """(model, optimizer, train_step, corpus) with the canonical tiny
+    hyperparams. Import jax lazily so workers can pin their platform env
+    before anything touches the backend."""
+    import jax
+    import optax
+
+    from keystone_tpu.models import lm_transformer as lm
+
+    model = lm.TransformerLM.create(
+        jax.random.key(key_seed), vocab=VOCAB, max_seq=SEQ, dim=DIM,
+        depth=DEPTH, num_heads=HEADS,
+    )
+    optimizer = optax.adamw(LR)
+    step = lm.make_train_step(optimizer)
+    corpus = lm.synthetic_corpus(20_000, VOCAB, seed=0)
+    return model, optimizer, step, corpus
+
+
+def step_batch(corpus, i: int):
+    from keystone_tpu.models import lm_transformer as lm
+
+    return lm._step_batch(corpus, 0, i, BATCH, SEQ)

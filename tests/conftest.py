@@ -57,3 +57,32 @@ def mesh4x2(devices):
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+@pytest.fixture
+def free_tcp_port_factory():
+    """Self-contained port allocator for the multihost coordinator tests
+    (no dependency on anyio's plugin fixtures): bind to port 0, read the
+    OS-assigned port, close so the coordinator can bind it. A seen-set
+    guards repeated calls in one test against the kernel handing the
+    just-released port straight back."""
+    import socket
+
+    seen = set()
+
+    def factory() -> int:
+        while True:
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            s.close()
+            if port not in seen:
+                seen.add(port)
+                return port
+
+    return factory
+
+
+@pytest.fixture
+def free_tcp_port(free_tcp_port_factory):
+    return free_tcp_port_factory()

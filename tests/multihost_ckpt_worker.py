@@ -21,7 +21,7 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-BATCH, SEQ = 8, 32
+from _lm_worker_common import BATCH, build, step_batch  # noqa: E402
 
 
 def main() -> None:
@@ -34,10 +34,8 @@ def main() -> None:
         sys.argv[6],
     )
     import numpy as np
-    import optax
 
     from keystone_tpu.core.checkpoint import TrainCheckpointer
-    from keystone_tpu.models import lm_transformer as lm
     from keystone_tpu.parallel import multihost
     from keystone_tpu.parallel.mesh import create_mesh
 
@@ -48,14 +46,8 @@ def main() -> None:
     )
     mesh = create_mesh(data=jax.device_count())
 
-    model = lm.TransformerLM.create(
-        jax.random.key(0), vocab=31, max_seq=SEQ, dim=32, depth=2,
-        num_heads=2,
-    )
-    optimizer = optax.adamw(1e-3)
+    model, optimizer, step, corpus = build()
     opt_state = optimizer.init(model)
-    step = lm.make_train_step(optimizer)
-    corpus = lm.synthetic_corpus(20_000, 31, seed=0)
     steps = 2 if phase == "crash" else 4
 
     ckpt = TrainCheckpointer(ckdir, {"kind": "mh_lm", "batch": BATCH})
@@ -65,7 +57,7 @@ def main() -> None:
             assert start == 2, f"resume found start={start}"
         lo, hi = pid * BATCH // nprocs, (pid + 1) * BATCH // nprocs
         for i in range(start, steps):
-            toks = lm._step_batch(corpus, 0, i, BATCH, SEQ)
+            toks = step_batch(corpus, i)
             g_toks = multihost.global_batch_from_local(
                 np.ascontiguousarray(toks[lo:hi]), mesh
             )

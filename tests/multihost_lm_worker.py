@@ -19,7 +19,7 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-STEPS, BATCH, SEQ = 3, 8, 32
+from _lm_worker_common import BATCH, SEQ, STEPS_LM as STEPS, build, step_batch  # noqa: E402
 
 
 def main() -> None:
@@ -30,9 +30,7 @@ def main() -> None:
         sys.argv[4],
     )
     import numpy as np
-    import optax
 
-    from keystone_tpu.models import lm_transformer as lm
     from keystone_tpu.parallel import multihost
     from keystone_tpu.parallel.mesh import create_mesh
 
@@ -43,19 +41,13 @@ def main() -> None:
     )
     mesh = create_mesh(data=jax.device_count())
 
-    model = lm.TransformerLM.create(
-        jax.random.key(0), vocab=31, max_seq=SEQ, dim=32, depth=2,
-        num_heads=2,
-    )
-    optimizer = optax.adamw(1e-3)
+    model, optimizer, step, corpus = build()
     opt_state = optimizer.init(model)
-    step = lm.make_train_step(optimizer)
 
-    corpus = lm.synthetic_corpus(20_000, 31, seed=0)
     losses = []
     lo, hi = pid * BATCH // nprocs, (pid + 1) * BATCH // nprocs
     for i in range(STEPS):
-        toks = lm._step_batch(corpus, 0, i, BATCH, SEQ)
+        toks = step_batch(corpus, i)
         g_toks = multihost.global_batch_from_local(
             np.ascontiguousarray(toks[lo:hi]), mesh
         )

@@ -201,7 +201,12 @@ def test_success_persists_tpu_record(monkeypatch, tmp_path, capsys):
         lambda: {"tokens_per_s": 3.0, "tflops_per_s": 0.004},
     )
     monkeypatch.setattr(
-        bench, "bench_lm_decode", lambda: {"decode_tokens_per_s": 2.0}
+        bench,
+        "bench_lm_decode",
+        lambda: {
+            "decode_tokens_per_s": 2.0,
+            "decode_int8_tokens_per_s": 3.0,
+        },
     )
     monkeypatch.setattr(
         bench,

@@ -133,26 +133,19 @@ LM_WORKER = Path(__file__).with_name("multihost_lm_worker.py")
 
 def _single_process_lm_reference(steps: int):
     """The uninterrupted one-process training run both LM multihost tests
-    compare against — hyperparams must match the workers
-    (multihost_lm_worker.py / multihost_ckpt_worker.py)."""
-    import jax
+    compare against — same shared setup as the workers
+    (tests/_lm_worker_common.py), so hyperparams can't drift apart."""
     import jax.numpy as jnp
-    import optax
 
-    from keystone_tpu.models import lm_transformer as lm
+    from _lm_worker_common import build, step_batch
 
-    model = lm.TransformerLM.create(
-        jax.random.key(0), vocab=31, max_seq=32, dim=32, depth=2,
-        num_heads=2,
-    )
-    optimizer = optax.adamw(1e-3)
+    model, optimizer, step, corpus = build()
     opt_state = optimizer.init(model)
-    step = lm.make_train_step(optimizer)
-    corpus = lm.synthetic_corpus(20_000, 31, seed=0)
     losses = []
     for i in range(steps):
-        toks = jnp.asarray(lm._step_batch(corpus, 0, i, 8, 32))
-        model, opt_state, loss = step(model, opt_state, toks)
+        model, opt_state, loss = step(
+            model, opt_state, jnp.asarray(step_batch(corpus, i))
+        )
         losses.append(float(loss))
     return model, losses
 
@@ -167,8 +160,6 @@ def test_two_process_lm_training_matches_single_process(
     out = tmp_path / "lm.npz"
     logs = _run_workers(LM_WORKER, out, free_tcp_port)
     assert out.exists(), "process 0 wrote no LM state\n" + "\n".join(logs)
-
-    import jax  # noqa: F401 — keeps the reference on the test process
 
     model, losses = _single_process_lm_reference(3)
 
