@@ -407,20 +407,40 @@ class KVCache:
     generate loop compiles to ONE program (prefill + a lax.scan of decode
     steps) with in-place `dynamic_update_slice` writes, no retracing as
     the sequence grows (the XLA analog of the reference's nothing: it has
-    no autoregressive models)."""
+    no autoregressive models).
+
+    With ``kv_dtype="int8"`` the buffers hold per-position symmetric int8
+    with (L, B, H, S_max, 1) scales: at long context the cache, not the
+    weights, dominates each decode step's HBM reads, and the scales pull
+    OUT of both dots exactly (scores = (q·k_q^T)·scale_k; out =
+    (p·scale_v)·v_q), so nothing dequantized ever materializes."""
 
     k: jnp.ndarray
     v: jnp.ndarray
     pos: jnp.ndarray  # scalar int32
+    k_scale: jnp.ndarray | None = None
+    v_scale: jnp.ndarray | None = None
 
 
-def prefill(model: TransformerLM, tokens, s_max: int):
+def _kv_quant(t):
+    """(..., hd) → (int8 codes, f32 scale (..., 1)) per-position — the
+    shared symmetric recipe pooling over the head dim."""
+    from keystone_tpu.ops.quantization import symmetric_int8
+
+    return symmetric_int8(t, (-1,))
+
+
+def prefill(model: TransformerLM, tokens, s_max: int,
+            kv_dtype: str | None = None):
     """Run the prompt through the model once, capturing per-layer K/V into
-    an ``s_max``-long cache. Returns (last-position logits (B, V), cache).
-    Local attention only (sequence-parallel decode shards the cache — use
-    ring/Ulysses for training, gather to local for decode)."""
+    an ``s_max``-long cache (optionally int8 — see :class:`KVCache`).
+    Returns (last-position logits (B, V), cache). Local attention only
+    (sequence-parallel decode shards the cache — use ring/Ulysses for
+    training, gather to local for decode)."""
     if model.seq_mode != "local":
         raise ValueError("prefill/decode require seq_mode='local'")
+    if kv_dtype not in (None, "int8"):
+        raise ValueError(f"kv_dtype={kv_dtype!r}; expected None|'int8'")
     cdt = jnp.dtype(model.compute_dtype)
     n, s = tokens.shape
     x = _embed(model, tokens, cdt)
@@ -436,11 +456,19 @@ def prefill(model: TransformerLM, tokens, s_max: int):
         vs.append(v)
     logits = _tied_logits(x[:, -1:], model.embed, cdt)[:, 0]
     pad = [(0, 0), (0, 0), (0, s_max - s), (0, 0)]
-    cache = KVCache(
-        k=jnp.stack([jnp.pad(k, pad) for k in ks]),
-        v=jnp.stack([jnp.pad(v, pad) for v in vs]),
-        pos=jnp.asarray(s, jnp.int32),
-    )
+    k_stack = jnp.stack([jnp.pad(k, pad) for k in ks])
+    v_stack = jnp.stack([jnp.pad(v, pad) for v in vs])
+    if kv_dtype == "int8":
+        kq, ksc = _kv_quant(k_stack)
+        vq, vsc = _kv_quant(v_stack)
+        cache = KVCache(
+            k=kq, v=vq, pos=jnp.asarray(s, jnp.int32),
+            k_scale=ksc, v_scale=vsc,
+        )
+    else:
+        cache = KVCache(
+            k=k_stack, v=v_stack, pos=jnp.asarray(s, jnp.int32)
+        )
     return logits, cache
 
 
@@ -461,11 +489,13 @@ def decode_step(model: TransformerLM, token, cache: KVCache):
     x = x.astype(cdt)
 
     valid = (jnp.arange(cache.k.shape[3]) <= pos)[None, None, None, :]
+    quantized = cache.k_scale is not None
     new_k, new_v = cache.k, cache.v
+    new_ks, new_vs = cache.k_scale, cache.v_scale
 
     def cached_attn(i):
         def attn(y, blk):
-            nonlocal new_k, new_v
+            nonlocal new_k, new_v, new_ks, new_vs
             q, k1, v1 = (
                 _split_heads(y, w, h) for w in (blk.wq, blk.wk, blk.wv)
             )
@@ -474,6 +504,15 @@ def decode_step(model: TransformerLM, token, cache: KVCache):
                 # keys were stored rotated by prefill / earlier steps
                 q = _rope(q, pos[None])
                 k1 = _rope(k1, pos[None])
+            if quantized:
+                k1, k1s = _kv_quant(k1)
+                v1, v1s = _kv_quant(v1)
+                new_ks = jax.lax.dynamic_update_slice(
+                    new_ks, k1s[None], (i, 0, 0, pos, 0)
+                )
+                new_vs = jax.lax.dynamic_update_slice(
+                    new_vs, v1s[None], (i, 0, 0, pos, 0)
+                )
             # one 5-D in-place update per buffer — not gather + rewrite,
             # which XLA may lower to an O(L·S_max) cache copy per layer
             new_k = jax.lax.dynamic_update_slice(
@@ -488,8 +527,13 @@ def decode_step(model: TransformerLM, token, cache: KVCache):
                 layer_k.transpose(0, 1, 3, 2).astype(cdt),
                 preferred_element_type=jnp.float32,
             ) / math.sqrt(hd)
+            if quantized:
+                # per-position scales pull out of the contraction exactly
+                scores = scores * new_ks[i][..., 0][:, :, None, :]
             scores = jnp.where(valid, scores, -1e30)
             probs = jax.nn.softmax(scores, axis=-1)
+            if quantized:
+                probs = probs * new_vs[i][..., 0][:, :, None, :]
             out = jnp.matmul(
                 probs.astype(cdt), layer_v.astype(cdt),
                 preferred_element_type=jnp.float32,
@@ -510,7 +554,9 @@ def decode_step(model: TransformerLM, token, cache: KVCache):
     # onto S_max-1 and return plausible-but-wrong logits; pos is traced,
     # so the honest device-side failure is loud NaNs, not an exception
     logits = jnp.where(pos < cache.k.shape[3], logits, jnp.nan)
-    return logits, KVCache(k=new_k, v=new_v, pos=pos + 1)
+    return logits, KVCache(
+        k=new_k, v=new_v, pos=pos + 1, k_scale=new_ks, v_scale=new_vs
+    )
 
 
 def _filter_logits(logits, top_k: int, top_p: float):
@@ -543,7 +589,8 @@ def _filter_logits(logits, top_k: int, top_p: float):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("max_new", "temperature", "top_k", "top_p")
+    jax.jit,
+    static_argnames=("max_new", "temperature", "top_k", "top_p", "kv_dtype"),
 )
 def generate(
     model: TransformerLM,
@@ -553,12 +600,15 @@ def generate(
     temperature: float = 0.0,
     top_k: int = 0,
     top_p: float = 0.0,
+    kv_dtype: str | None = None,
     key=None,
 ):
     """Greedy (temperature=0) or sampled decode of ``max_new`` tokens after
     ``prompt`` (B, P). One jitted program: prefill + lax.scan over steps.
     ``top_k``/``top_p`` (nucleus) restrict sampling to the head of the
-    distribution (0 = off; both compose). Returns (B, max_new) int32."""
+    distribution (0 = off; both compose); ``kv_dtype="int8"`` halves the
+    cache stream at long context (see :class:`KVCache`). Returns
+    (B, max_new) int32."""
     if key is None:
         key = jax.random.key(0)
     s_max = prompt.shape[1] + max_new
@@ -566,7 +616,7 @@ def generate(
         raise ValueError(
             f"prompt+max_new={s_max} exceeds max_seq={model.pos_embed.shape[0]}"
         )
-    logits0, cache = prefill(model, prompt, s_max)
+    logits0, cache = prefill(model, prompt, s_max, kv_dtype=kv_dtype)
 
     def pick(logits, k):
         if temperature == 0.0:

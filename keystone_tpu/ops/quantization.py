@@ -43,18 +43,28 @@ class QTensor:
         return self.q.astype(jnp.float32) * self.scale
 
 
+def symmetric_int8(w, reduce_axes):
+    """The one symmetric-int8 recipe (amax/127 scales, round, clip ±127)
+    shared by weight and KV-cache quantization — ``reduce_axes`` are the
+    axes the scale pools over (keepdims). Returns (int8 codes, f32
+    scale)."""
+    w = jnp.asarray(w, jnp.float32)
+    amax = jnp.max(jnp.abs(w), axis=reduce_axes, keepdims=True)
+    scale = jnp.maximum(amax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
 def quantize_int8(w, *, channel_axis: int = -1) -> QTensor:
     """Per-channel symmetric quantization: scales are max|w|/127 along
     every axis EXCEPT ``channel_axis`` (the one that stays per-channel).
     channel_axis=-1 suits (in, out) weights; 0 suits (V, d) embeddings
     (per-row, so both the gather and the tied-logit transpose see a
     per-output scale)."""
-    w = jnp.asarray(w, jnp.float32)
+    w = jnp.asarray(w)
     channel_axis = channel_axis % w.ndim
     reduce_axes = tuple(a for a in range(w.ndim) if a != channel_axis)
-    amax = jnp.max(jnp.abs(w), axis=reduce_axes, keepdims=True)
-    scale = jnp.maximum(amax, 1e-12) / 127.0
-    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    q, scale = symmetric_int8(w, reduce_axes)
     return QTensor(q=q, scale=scale)
 
 

@@ -98,3 +98,40 @@ def test_quantize_skips_moe_and_zero_width():
     toks = jnp.asarray(np.random.default_rng(0).integers(0, 31, size=(2, 8)))
     out = q(toks)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_int8_kv_cache_decode_close_to_full():
+    """int8 KV cache: teacher-forced decode logits track the f32-cache
+    decode closely, and greedy generations agree on a trained model."""
+    corpus = lm.synthetic_corpus(20_000, 31, seed=2)
+    model = lm.TransformerLM.create(
+        jax.random.key(1), vocab=31, max_seq=64, dim=32, depth=2,
+        num_heads=2,
+    )
+    model, _ = lm.train(
+        model, corpus, steps=60, batch=8, seq=32, lr=2e-3, seed=2
+    )
+    rng = np.random.default_rng(3)
+    toks = jnp.asarray(rng.integers(0, 31, size=(2, 20)))
+    prompt, rest = toks[:, :10], toks[:, 10:]
+
+    lo_f, cache_f = lm.prefill(model, prompt, 20)
+    lo_q, cache_q = lm.prefill(model, prompt, 20, kv_dtype="int8")
+    assert cache_q.k.dtype == jnp.int8 and cache_q.k_scale is not None
+    np.testing.assert_allclose(
+        np.asarray(lo_q), np.asarray(lo_f), atol=1e-4
+    )  # prefill logits don't touch the cache
+    for j in range(rest.shape[1] - 1):
+        lo_f, cache_f = lm.decode_step(model, rest[:, j], cache_f)
+        lo_q, cache_q = lm.decode_step(model, rest[:, j], cache_q)
+        np.testing.assert_allclose(
+            np.asarray(lo_q), np.asarray(lo_f), atol=0.08,
+            err_msg=f"step {j}",
+        )
+
+    g_f = np.asarray(lm.generate(model, prompt, max_new=10))
+    g_q = np.asarray(lm.generate(model, prompt, max_new=10,
+                                 kv_dtype="int8"))
+    assert (g_f == g_q).mean() >= 0.8, (g_f, g_q)
+    with pytest.raises(ValueError, match="kv_dtype"):
+        lm.prefill(model, prompt, 20, kv_dtype="int4")
