@@ -29,6 +29,17 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 
+def _check_seq_divisible(q, mesh, seq_axis: str) -> None:
+    """Loud precondition shared by ring/Ulysses — shard_map's own error
+    for a non-divisible spec is opaque."""
+    n = mesh.shape[seq_axis]
+    if q.shape[2] % n:
+        raise ValueError(
+            f"sequence length {q.shape[2]} not divisible by the "
+            f"{seq_axis!r} axis ({n} devices)"
+        )
+
+
 def _flash_default() -> bool:
     """Fused Pallas kernels by default on real TPU hardware only."""
     from keystone_tpu.ops.flash_attention import on_tpu
@@ -276,6 +287,7 @@ def ring_attention(
     """
     if use_flash is None:
         use_flash = _flash_default()
+    _check_seq_divisible(q, mesh, seq_axis)
     spec = P(None, None, seq_axis, None)
     if trainable:
         body = lambda q_, k_, v_: _ring_shard_trainable(  # noqa: E731
@@ -363,6 +375,7 @@ def ulysses_attention(
     n = mesh.shape[seq_axis]
     if q.shape[1] % n:
         raise ValueError(f"heads ({q.shape[1]}) not divisible by axis ({n})")
+    _check_seq_divisible(q, mesh, seq_axis)
     spec = P(None, None, seq_axis, None)
     fn = jax.shard_map(
         partial(

@@ -133,3 +133,11 @@ def test_ulysses_trainable_grads_match_dense(mesh8, rng, use_flash):
             np.asarray(gu), np.asarray(gd), atol=2e-3,
             err_msg=f"d{name} (flash={use_flash})",
         )
+
+
+def test_sequence_not_divisible_fails_loudly(mesh8, rng):
+    q, k, v = _qkv(rng, s=100)  # 100 % 8 != 0
+    with pytest.raises(ValueError, match="not divisible"):
+        ring_attention(q, k, v, mesh8, seq_axis="data")
+    with pytest.raises(ValueError, match="not divisible"):
+        ulysses_attention(q, k, v, mesh8, seq_axis="data")
