@@ -21,7 +21,8 @@ Package layout (mirrors SURVEY.md §2 of the reference analysis):
 - ``loaders``    host-side data ingestion feeding sharded device arrays
 - ``evaluation`` multiclass / binary / mean-AP evaluators
 - ``models``     end-to-end applications (MNIST, CIFAR, VOC, ImageNet, TIMIT,
-                 Newsgroups, n-gram LM)
+                 Newsgroups, n-gram LM, transformer LM with the full
+                 dp × tp × sp × ep × pp matrix)
 """
 
 from keystone_tpu.core.pipeline import (
@@ -45,7 +46,7 @@ from keystone_tpu.parallel.mesh import (
     shard_batch,
 )
 
-__version__ = "0.2.0"
+__version__ = "0.3.0"
 
 __all__ = [
     "Estimator",
