@@ -65,10 +65,9 @@ def _ln(x, cdt):
 
 
 def _split_heads(y, w, h):
-    n, s, d = y.shape
-    return (
-        mm(y, w, y.dtype).reshape(n, s, h, d // h).transpose(0, 2, 1, 3)
-    )
+    n, s, _ = y.shape
+    out = mm(y, w, y.dtype)  # (n, s, h·hd) — rectangular for GQA K/V
+    return out.reshape(n, s, h, out.shape[-1] // h).transpose(0, 2, 1, 3)
 
 
 def _rope(x, positions, base: float = 10_000.0):
@@ -166,20 +165,44 @@ class TransformerLM:
     # "rope" = rotary q/k phases — no table, no length cap beyond memory,
     # the right pairing for the blockwise long-context backward
     pos_encoding: str = static_field(default="learned")
+    # grouped-query attention: K/V carry this many heads (0 = num_heads,
+    # plain MHA; 1 = MQA). The decode cache shrinks by num_heads/kv_heads
+    # — composing with kv_dtype="int8" for the full serving story
+    num_kv_heads: int = static_field(default=0)
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    def _qkv_heads(self, x, blk: LMBlock, positions=None):
+        """(q with H heads, k/v with KV heads, rope applied).
+        ``positions`` defaults to 0..S-1 (full-sequence forward); decode
+        passes the single global position of its new token."""
+        q = _split_heads(x, blk.wq, self.num_heads)
+        k = _split_heads(x, blk.wk, self.kv_heads)
+        v = _split_heads(x, blk.wv, self.kv_heads)
+        if self.pos_encoding == "rope":
+            if positions is None:
+                positions = jnp.arange(x.shape[1])
+            q = _rope(q, positions)
+            k = _rope(k, positions)
+        return q, k, v
 
     def _attention(self, x, blk: LMBlock, return_kv: bool = False):
         n, s, d = x.shape
         h = self.num_heads
 
-        q, k, v = (
-            _split_heads(x, w, h) for w in (blk.wq, blk.wk, blk.wv)
-        )
-        if self.pos_encoding == "rope":
-            # x is always the full (global) sequence here — the
-            # sequence-parallel paths shard inside ring/ulysses_attention
-            positions = jnp.arange(s)
-            q = _rope(q, positions)
-            k = _rope(k, positions)
+        # x is always the full (global) sequence here — the
+        # sequence-parallel paths shard inside ring/ulysses_attention
+        q, k, v = self._qkv_heads(x, blk)
+        kv_raw = (k, v)  # pre-broadcast: what the decode cache stores
+        if self.kv_heads != h:
+            # training/prefill compute broadcasts K/V up to H heads
+            # (activation-sized, the standard GQA training treatment);
+            # the grouped decode path never materializes this
+            g = h // self.kv_heads
+            k = jnp.repeat(k, g, axis=1)
+            v = jnp.repeat(v, g, axis=1)
         # sequence-parallel training runs the custom-VJP bodies: the ring
         # backward circulates dk/dv accumulators around the ring (the
         # per-hop Pallas forward kernels are forward-only), Ulysses
@@ -214,7 +237,7 @@ class TransformerLM:
             x.dtype,
         )
         if return_kv:
-            return proj, (k, v)
+            return proj, kv_raw
         return proj
 
     def _moe(self, i: int):
@@ -262,6 +285,7 @@ class TransformerLM:
         num_experts: int = 8,
         capacity_factor: float = 1.25,
         pos_encoding: str = "learned",
+        num_kv_heads: int = 0,
     ) -> "TransformerLM":
         """``moe_every=k`` replaces the dense FFN of every k-th block with
         a top-2 routed :class:`~keystone_tpu.ops.moe.MoELayer` of
@@ -277,6 +301,16 @@ class TransformerLM:
                 f"rope needs an even head dim; got dim/num_heads = "
                 f"{dim}/{num_heads} = {dim // num_heads}"
             )
+        kvh = num_kv_heads or num_heads
+        if kvh <= 0 or num_heads % kvh:
+            raise ValueError(
+                f"num_heads={num_heads} not divisible by "
+                f"num_kv_heads={kvh}"
+            )
+        # canonical static field: 0 means MHA, so kvh == num_heads
+        # normalizes to 0 (num_kv_heads=H and =0 are the same model)
+        num_kv_heads = 0 if kvh == num_heads else kvh
+        kv_dim = kvh * (dim // num_heads)
         # the split count and per-block stride must not depend on
         # moe_every: dense models seeded before MoE existed must keep
         # bit-identical weights, so MoE keys are folded in separately
@@ -293,8 +327,8 @@ class TransformerLM:
             blocks.append(
                 LMBlock(
                     wq=init(ks[0], (dim, dim), dim),
-                    wk=init(ks[1], (dim, dim), dim),
-                    wv=init(ks[2], (dim, dim), dim),
+                    wk=init(ks[1], (dim, kv_dim), dim),
+                    wv=init(ks[2], (dim, kv_dim), dim),
                     wo=init(ks[3], (dim, dim), dim),
                     # a MoE block's dense FFN is never applied — zero-width
                     # placeholders keep the pytree structure uniform
@@ -332,6 +366,7 @@ class TransformerLM:
             compute_dtype=compute_dtype,
             moe_layers=tuple(moes) if moe_every else (),
             pos_encoding=pos_encoding,
+            num_kv_heads=num_kv_heads,
         )
 
     def num_params(self) -> int:
@@ -402,8 +437,9 @@ def shard_params(model: TransformerLM, mesh) -> TransformerLM:
 
 @treenode
 class KVCache:
-    """Preallocated decode cache: static (L, B, H, S_max, hd) buffers plus
-    the number of valid positions. Static shapes are the point — the whole
+    """Preallocated decode cache: static (L, B, KV_heads, S_max, hd)
+    buffers (KV_heads < num_heads under GQA — that ratio IS the cache
+    saving) plus the number of valid positions. Static shapes are the point — the whole
     generate loop compiles to ONE program (prefill + a lax.scan of decode
     steps) with in-place `dynamic_update_slice` writes, no retracing as
     the sequence grows (the XLA analog of the reference's nothing: it has
@@ -493,17 +529,16 @@ def decode_step(model: TransformerLM, token, cache: KVCache):
     new_k, new_v = cache.k, cache.v
     new_ks, new_vs = cache.k_scale, cache.v_scale
 
+    kvh = model.kv_heads
+    g = h // kvh  # query heads per K/V head (1 = plain MHA)
+
     def cached_attn(i):
         def attn(y, blk):
             nonlocal new_k, new_v, new_ks, new_vs
-            q, k1, v1 = (
-                _split_heads(y, w, h) for w in (blk.wq, blk.wk, blk.wv)
-            )
-            if model.pos_encoding == "rope":
-                # rotate the single new q/k at its global position; cached
-                # keys were stored rotated by prefill / earlier steps
-                q = _rope(q, pos[None])
-                k1 = _rope(k1, pos[None])
+            # the shared split+rope helper, at the new token's global
+            # position; cached keys were stored rotated by prefill /
+            # earlier steps
+            q, k1, v1 = model._qkv_heads(y, blk, positions=pos[None])
             if quantized:
                 k1, k1s = _kv_quant(k1)
                 v1, v1s = _kv_quant(v1)
@@ -522,24 +557,30 @@ def decode_step(model: TransformerLM, token, cache: KVCache):
                 new_v, v1[None].astype(new_v.dtype), (i, 0, 0, pos, 0)
             )
             layer_k, layer_v = new_k[i], new_v[i]
-            scores = jnp.matmul(
-                q.astype(cdt),
-                layer_k.transpose(0, 1, 3, 2).astype(cdt),
+            # grouped attention (MHA is the g=1 special case): q heads
+            # regroup as (KV, G) against the KV-head cache — no repeated
+            # K/V ever materializes, which is GQA's decode point
+            qg = q.reshape(n, kvh, g, 1, hd).astype(cdt)
+            scores = jnp.einsum(
+                "bkgqd,bksd->bkgqs", qg, layer_k.astype(cdt),
                 preferred_element_type=jnp.float32,
             ) / math.sqrt(hd)
             if quantized:
                 # per-position scales pull out of the contraction exactly
-                scores = scores * new_ks[i][..., 0][:, :, None, :]
-            scores = jnp.where(valid, scores, -1e30)
+                scores = scores * new_ks[i][..., 0][:, :, None, None, :]
+            scores = jnp.where(valid[:, :, None], scores, -1e30)
             probs = jax.nn.softmax(scores, axis=-1)
             if quantized:
-                probs = probs * new_vs[i][..., 0][:, :, None, :]
-            out = jnp.matmul(
-                probs.astype(cdt), layer_v.astype(cdt),
+                probs = probs * new_vs[i][..., 0][:, :, None, None, :]
+            out = jnp.einsum(
+                "bkgqs,bksd->bkgqd", probs.astype(cdt),
+                layer_v.astype(cdt),
                 preferred_element_type=jnp.float32,
             )
             proj = mm(
-                out.transpose(0, 2, 1, 3).reshape(n, 1, d).astype(cdt),
+                out.reshape(n, h, 1, hd).transpose(0, 2, 1, 3).reshape(
+                    n, 1, d
+                ).astype(cdt),
                 blk.wo,
                 cdt,
             )
@@ -950,6 +991,9 @@ def train(
                 "schedule": schedule,
                 "grad_clip": grad_clip,
                 "num_heads": model.num_heads,
+                # normalized (kv_heads, never the 0 alias) so MHA spelled
+                # either way compares equal
+                "num_kv_heads": model.kv_heads,
                 "seq_mode": model.seq_mode,
                 "compute_dtype": model.compute_dtype,
                 "pos_encoding": model.pos_encoding,
@@ -979,6 +1023,8 @@ def train(
                 "pos_encoding": "learned",
                 "schedule": "constant",
                 "grad_clip": 0.0,
+                # pre-GQA checkpoints were all MHA
+                "num_kv_heads": model.num_heads,
             },
         )
     try:
@@ -1050,6 +1096,11 @@ class LMConfig:
     dim: int = arg(default=256)
     depth: int = arg(default=4)
     num_heads: int = arg(default=8)
+    num_kv_heads: int = arg(
+        default=0,
+        help="GQA: K/V heads (0 = num_heads/MHA, 1 = MQA); shrinks the "
+        "decode cache by num_heads/num_kv_heads",
+    )
     vocab: int = arg(default=256)
     lr: float = arg(default=3e-4)
     seq_mode: str = arg(
@@ -1119,6 +1170,7 @@ def run(conf: LMConfig, mesh=None) -> dict:
         moe_every=conf.moe_every,
         num_experts=conf.num_experts,
         pos_encoding=conf.pos_encoding,
+        num_kv_heads=conf.num_kv_heads,
     )
     model = shard_params(model, mesh)
     if not conf.corpus:
