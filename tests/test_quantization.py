@@ -135,3 +135,30 @@ def test_int8_kv_cache_decode_close_to_full():
     assert (g_f == g_q).mean() >= 0.8, (g_f, g_q)
     with pytest.raises(ValueError, match="kv_dtype"):
         lm.prefill(model, prompt, 20, kv_dtype="int4")
+
+
+def test_lm_serialization_roundtrip_including_quantized(tmp_path):
+    """save_pipeline/load_pipeline round-trip the LM pytree — float and
+    int8-quantized (QTensor leaves) — with identical generations after
+    reload (the deploy-a-served-model path)."""
+    from keystone_tpu.core.serialization import load_pipeline, save_pipeline
+
+    model = lm.TransformerLM.create(
+        jax.random.key(0), vocab=31, max_seq=32, dim=32, depth=2,
+        num_heads=4, num_kv_heads=2, pos_encoding="rope",
+    )
+    prompt = jnp.asarray([[1, 2, 3]])
+    for name, m in (
+        ("float", model),
+        ("int8", lm.quantize_for_decode(model)),
+    ):
+        p = str(tmp_path / f"lm_{name}.pkl")
+        save_pipeline(m, p)
+        m2 = load_pipeline(p)
+        assert type(m2) is lm.TransformerLM
+        g1 = np.asarray(lm.generate(m, prompt, max_new=8))
+        g2 = np.asarray(lm.generate(m2, prompt, max_new=8))
+        np.testing.assert_array_equal(g1, g2, err_msg=name)
+        if name == "int8":
+            assert isinstance(m2.blocks[0].wq, QTensor)
+            assert m2.blocks[0].wq.q.dtype == jnp.int8
