@@ -924,7 +924,8 @@ def train(
 
     ``checkpoint_dir`` makes the run preemption-safe: model + optimizer
     state are orbax-checkpointed every ``checkpoint_every`` steps (default
-    every step when a dir is set), and a rerun with the same arguments
+    0 = ``steps // 10``, ~10 checkpoints per run), and a rerun with the
+    same arguments
     resumes from the last completed step on the *identical* trajectory —
     batches are derived per-step from ``(seed, i)``, not from sequential
     RNG state (the LM analog of the solvers' ``resumable_fit``). ``losses``
@@ -1136,7 +1137,8 @@ class LMConfig:
         help="orbax checkpoint/resume directory (preemption-safe training)",
     )
     checkpoint_every: int = arg(
-        default=0, help="steps between checkpoints (0 = every step)"
+        default=0,
+        help="steps between checkpoints (0 = steps//10, ~10 per run)",
     )
 
 
@@ -1197,9 +1199,14 @@ def run(conf: LMConfig, mesh=None) -> dict:
         # a resume that found the run already complete trains 0 steps
         losses = [float("nan")]
     res = {
+        # loss_first is the first loss of THIS segment; on a resumed run
+        # (steps_ran < steps) it is not the run's true initial loss —
+        # downstream records key off `resumed` to tell the cases apart
         "loss_first": losses[0],
         "loss_last": float(np.mean(losses[-5:])),
         "steps": conf.steps,
+        "steps_ran": steps_ran,
+        "resumed": steps_ran < conf.steps,
         "params": model.num_params(),
         "tokens_per_s": steps_ran * conf.batch * conf.seq / dt,
         "wall_s": dt,

@@ -74,7 +74,11 @@ def mm(y, w, dt):
     activation-sized result; the convert-into-dot is left to XLA fusion
     (see module docstring)."""
     if isinstance(w, QTensor):
-        return (y @ w.q.astype(dt)) * w.scale.astype(dt)
+        # scale stays f32: rounding it to bf16 first would add ~0.4%
+        # relative error to every element of a channel on top of the int8
+        # rounding; the single cast of the product is the cost of the
+        # output dtype, not an avoidable one
+        return ((y @ w.q.astype(dt)) * w.scale).astype(dt)
     return y @ w.astype(dt)
 
 
