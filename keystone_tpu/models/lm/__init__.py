@@ -1,0 +1,58 @@
+"""Decoder-only transformer LM, split by lifecycle:
+
+- :mod:`.model` — architecture (TransformerLM/LMBlock), TP layout,
+  losses, analytic FLOPs;
+- :mod:`.train` — optimizers, the jitted dp/tp and pipeline-parallel
+  train steps, the checkpointed training loop, corpora;
+- :mod:`.decode` — KV-cache serving: prefill, decode, sampling,
+  weight-only int8 quantization.
+
+:mod:`keystone_tpu.models.lm_transformer` re-exports this surface (plus
+the CLI) and remains the stable import path.
+"""
+
+from keystone_tpu.models.lm.decode import (
+    KVCache,
+    decode_step,
+    generate,
+    prefill,
+    quantize_for_decode,
+)
+from keystone_tpu.models.lm.model import (
+    LMBlock,
+    TransformerLM,
+    next_token_loss,
+    shard_params,
+    token_cross_entropy,
+    train_step_flops,
+)
+from keystone_tpu.models.lm.train import (
+    make_optimizer,
+    make_pp_train_step,
+    make_train_step,
+    next_token_loss_pp,
+    pp_forward,
+    synthetic_corpus,
+    train,
+)
+
+__all__ = [
+    "KVCache",
+    "LMBlock",
+    "TransformerLM",
+    "decode_step",
+    "generate",
+    "make_optimizer",
+    "make_pp_train_step",
+    "make_train_step",
+    "next_token_loss",
+    "next_token_loss_pp",
+    "pp_forward",
+    "prefill",
+    "quantize_for_decode",
+    "shard_params",
+    "synthetic_corpus",
+    "token_cross_entropy",
+    "train",
+    "train_step_flops",
+]

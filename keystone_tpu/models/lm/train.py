@@ -1,0 +1,367 @@
+"""Training for the transformer LM: the jitted dp/tp step, the GPipe
+pipeline-parallel step, the checkpointed loop, and corpora.
+
+One buffer-donated XLA program per step is the design rule (the idiom the
+framework's solvers use: one launch per step, no host round-trips), with
+preemption-safe orbax checkpointing whose resumed trajectory is exactly
+the uninterrupted one — batches derive from ``(seed, step)``, never from
+sequential RNG state.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as np
+import optax
+
+from keystone_tpu.core.logging import get_logger
+from keystone_tpu.models.lm.model import (
+    TransformerLM,
+    _block_apply,
+    _embed,
+    _tied_logits,
+    has_quantized_leaves,
+    next_token_loss,
+    token_cross_entropy,
+)
+
+logger = get_logger("keystone_tpu.models.lm_transformer")
+
+
+def pp_forward(model: TransformerLM, tokens, mesh, *, n_micro: int,
+               axis: str = "model", data_axis: str | None = None):
+    """Pipeline-parallel forward: the block chain runs as GPipe stages
+    over the mesh ``axis`` (one group of ``depth/n_stages`` blocks per
+    device, microbatches streamed via ppermute —
+    :func:`keystone_tpu.parallel.pipeline_parallel.gpipe`), embedding and
+    tied logits replicated outside the pipe. Completes the LM's
+    parallelism matrix (dp × tp × sp × ep × pp). Dense blocks only (MoE
+    routing wants the expert axis, not the stage axis); parameters stay
+    replicated in HBM — pp here parallelizes compute, the memory story
+    is remat + the other axes.
+    """
+    import jax.numpy as jnp
+
+    if any(m is not None for m in model.moe_layers):
+        raise ValueError(
+            "pipeline-parallel path supports dense blocks only (route "
+            "experts over the model axis with moe_every instead)"
+        )
+    if model.seq_mode != "local":
+        raise ValueError(
+            "pipeline-parallel path requires seq_mode='local': the "
+            f"{model.seq_mode!r} attention opens its own shard_map, which "
+            "cannot nest inside the pipeline's"
+        )
+    n_stages = mesh.shape[axis]
+    depth = len(model.blocks)
+    if depth % n_stages:
+        raise ValueError(
+            f"depth {depth} not divisible by {n_stages} pipeline stages"
+        )
+    b = tokens.shape[0]
+    if b % n_micro:
+        raise ValueError(
+            f"batch {b} not divisible by n_micro={n_micro}"
+        )
+    per = depth // n_stages
+    cdt = jnp.dtype(model.compute_dtype)
+    x = _embed(model, tokens, cdt)
+    # pre-split microbatches HERE: gpipe's n_micro reshape heuristic is
+    # ambiguous when B == n_micro (it would mistake (B, S, d) for an
+    # already-microbatched (n_micro, S, d))
+    x = x.reshape(n_micro, b // n_micro, *x.shape[1:])
+
+    # stack the per-block pytrees: leading axis depth → (stages, per)
+    stacked = jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *model.blocks
+    )
+    stacked = jax.tree_util.tree_map(
+        lambda l: l.reshape(n_stages, per, *l.shape[1:]), stacked
+    )
+
+    def stage_fn(stage_params, act):
+        for j in range(per):
+            blk = jax.tree_util.tree_map(lambda l: l[j], stage_params)
+            act = _block_apply(
+                act, blk, cdt,
+                lambda y, bb: (model._attention(y, bb), None),
+            )[0]
+        return act
+
+    if model.remat:
+        stage_fn = jax.checkpoint(stage_fn)
+    from keystone_tpu.parallel.pipeline_parallel import gpipe
+
+    out = gpipe(stage_fn, stacked, x, mesh, axis=axis, data_axis=data_axis)
+    out = out.reshape(b, *out.shape[2:])
+    return _tied_logits(out, model.embed, cdt)
+
+
+def next_token_loss_pp(model: TransformerLM, tokens, mesh, *,
+                       n_micro: int, axis: str = "model",
+                       data_axis: str | None = None):
+    """Next-token CE through the GPipe forward (differentiable: scan,
+    ppermute, and psum all have transposes — the backward is the reverse
+    pipeline schedule, derived by AD rather than hand-scheduled)."""
+    logits = pp_forward(
+        model, tokens[:, :-1], mesh, n_micro=n_micro, axis=axis,
+        data_axis=data_axis,
+    )
+    return token_cross_entropy(logits, tokens[:, 1:])
+
+
+def make_pp_train_step(optimizer, mesh, *, n_micro: int,
+                       axis: str = "model",
+                       data_axis: str | None = None):
+    """Buffer-donated jitted pipeline-parallel train step. ``data_axis``
+    composes dp × pp: each data-row of devices pipelines its own batch
+    slice (grad psums across rows come from XLA's sharding propagation —
+    params are replicated over the data axis)."""
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(model, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda m, t: next_token_loss_pp(
+                m, t, mesh, n_micro=n_micro, axis=axis,
+                data_axis=data_axis,
+            )
+        )(model, tokens)
+        updates, opt_state = optimizer.update(
+            grads, opt_state, params=model
+        )
+        model = optax.apply_updates(model, updates)
+        return model, opt_state, loss
+
+    return step
+
+
+def make_train_step(optimizer):
+    """One buffer-donated jitted program: grads + AdamW update + loss."""
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(model, opt_state, tokens):
+        loss, grads = jax.value_and_grad(next_token_loss)(model, tokens)
+        updates, opt_state = optimizer.update(
+            grads, opt_state, params=model
+        )
+        model = optax.apply_updates(model, updates)
+        return model, opt_state, loss
+
+    return step
+
+
+def _step_batch(corpus, seed: int, i: int, batch: int, seq: int):
+    """Step ``i``'s token windows, derived from ``(seed, i)`` alone — no
+    sequential RNG state, so a resumed run regenerates the exact batch
+    sequence an uninterrupted run would have seen."""
+    rng = np.random.default_rng(np.random.SeedSequence((seed, i)))
+    starts = rng.integers(0, len(corpus) - seq - 1, size=batch)
+    return np.stack([corpus[s : s + seq + 1] for s in starts])
+
+
+def make_optimizer(
+    lr: float,
+    *,
+    steps: int = 0,
+    schedule: str = "constant",
+    warmup_frac: float = 0.05,
+    grad_clip: float = 0.0,
+    weight_decay: float = 0.01,
+):
+    """The LM training optimizer: AdamW, optionally behind global-norm
+    gradient clipping, with a constant or warmup-cosine learning rate.
+    ``schedule="cosine"`` warms up over ``warmup_frac`` of ``steps`` and
+    decays to lr/10 — the standard LM recipe."""
+    if schedule not in ("constant", "cosine"):
+        raise ValueError(
+            f"schedule={schedule!r}; expected constant|cosine"
+        )
+    if schedule == "cosine":
+        if steps <= 0:
+            raise ValueError("schedule='cosine' needs the total steps")
+        lr = optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=lr,
+            warmup_steps=max(1, int(steps * warmup_frac)),
+            decay_steps=steps,
+            end_value=lr / 10.0,
+        )
+    opt = optax.adamw(lr, weight_decay=weight_decay)
+    if grad_clip > 0.0:
+        opt = optax.chain(optax.clip_by_global_norm(grad_clip), opt)
+    return opt
+
+
+def train(
+    model: TransformerLM,
+    corpus: np.ndarray,
+    *,
+    steps: int,
+    batch: int,
+    seq: int,
+    lr: float = 3e-4,
+    mesh=None,
+    seed: int = 0,
+    log_every: int = 0,
+    checkpoint_dir: str = "",
+    checkpoint_every: int = 0,
+    schedule: str = "constant",
+    grad_clip: float = 0.0,
+):
+    """Train on random windows of ``corpus`` (1-D int array). Returns
+    (model, losses). Batches are dp-sharded over the mesh ``data`` axis
+    unless the model is sequence-parallel (then S is the sharded axis and
+    the batch is replicated).
+
+    ``checkpoint_dir`` makes the run preemption-safe: model + optimizer
+    state are orbax-checkpointed every ``checkpoint_every`` steps (default
+    0 = ``steps // 10``, ~10 checkpoints per run), and a rerun with the
+    same arguments resumes from the last completed step on the *identical*
+    trajectory — batches are derived per-step from ``(seed, i)``, not from
+    sequential RNG state (the LM analog of the solvers' ``resumable_fit``).
+    ``losses`` covers only the steps this invocation ran. Note:
+    ``schedule="cosine"`` derives its decay horizon from THIS invocation's
+    ``steps`` — resuming with a longer schedule is allowed (steps are not
+    run identity) but stretches the cosine rather than replaying the
+    original horizon.
+    """
+    import hashlib
+
+    import jax.numpy as jnp
+
+    from keystone_tpu.parallel.mesh import data_sharding
+
+    if len(corpus) < seq + 2:
+        raise ValueError(
+            f"corpus of {len(corpus)} tokens is too short for seq={seq} "
+            f"(needs at least seq+2 = {seq + 2}); shorten --seq or grow "
+            "the corpus"
+        )
+    if has_quantized_leaves(model):
+        raise ValueError(
+            "model holds int8 QTensor weights (quantize_for_decode is "
+            "inference-only) — gradients through the rounding would be "
+            "silently zero; train the float model and re-quantize"
+        )
+    optimizer = make_optimizer(
+        lr, steps=steps, schedule=schedule, grad_clip=grad_clip
+    )
+    opt_state = optimizer.init(model)
+    step = make_train_step(optimizer)
+    losses = []
+    sharding = None
+    if (
+        mesh is not None
+        and model.seq_mode == "local"
+        and batch % mesh.shape.get("data", 1) == 0
+    ):
+        sharding = data_sharding(mesh, ndim=2)
+
+    ckpt = None
+    start = 0
+    if checkpoint_dir:
+        from keystone_tpu.core.checkpoint import TrainCheckpointer
+
+        # default cadence: ~10 checkpoints per run, not one per step — a
+        # jitted LM step is milliseconds while a synchronous full-state
+        # orbax save is not (resumable_fit's every=1 default amortizes
+        # over whole BCD passes, a much coarser unit)
+        every = checkpoint_every or max(steps // 10, 1)
+        corpus_head = np.asarray(corpus[:64], np.int64)
+        ckpt = TrainCheckpointer(
+            checkpoint_dir,
+            # `steps` is deliberately absent (resuming with a longer
+            # schedule is the point — the over-trained guard below covers
+            # the short case), mirroring resumable_fit's num_iter rule.
+            # Everything else that shapes the trajectory is here: a
+            # param-shape match alone would silently accept a different
+            # model function (num_heads, dtype policy, seq_mode...)
+            {
+                "kind": "lm_transformer",
+                "batch": batch,
+                "seq": seq,
+                "lr": lr,
+                "seed": seed,
+                "schedule": schedule,
+                "grad_clip": grad_clip,
+                "num_heads": model.num_heads,
+                # normalized (kv_heads, never the 0 alias) so MHA spelled
+                # either way compares equal
+                "num_kv_heads": model.kv_heads,
+                "seq_mode": model.seq_mode,
+                "compute_dtype": model.compute_dtype,
+                "pos_encoding": model.pos_encoding,
+                "remat": model.remat,
+                "moe_aux_weight": model.moe_aux_weight,
+                "moe_experts": [
+                    None if m is None else m.num_experts
+                    for m in model.moe_layers
+                ],
+                "moe_capacity": [
+                    None if m is None else m.capacity_factor
+                    for m in model.moe_layers
+                ],
+                "corpus_len": int(len(corpus)),
+                "corpus_head_sha": hashlib.sha256(
+                    corpus_head.tobytes()
+                ).hexdigest()[:16],
+                "param_shapes": [
+                    list(map(int, leaf.shape))
+                    for leaf in jax.tree_util.tree_leaves(model)
+                ],
+            },
+            # keys added after checkpoints already existed in the wild:
+            # an older sidecar without them must compare as the value the
+            # code used at the time, not brick the resume
+            legacy_defaults={
+                "pos_encoding": "learned",
+                "schedule": "constant",
+                "grad_clip": 0.0,
+                # pre-GQA checkpoints were all MHA
+                "num_kv_heads": model.num_heads,
+            },
+        )
+    try:
+        if ckpt is not None:
+            (model, opt_state), start = ckpt.restore((model, opt_state))
+            if start > steps:
+                raise ValueError(
+                    f"{checkpoint_dir} holds a step-{start} checkpoint but "
+                    f"this run is only {steps} steps — refusing to return "
+                    "an over-trained model; point at a fresh directory"
+                )
+        for i in range(start, steps):
+            toks = jnp.asarray(_step_batch(corpus, seed, i, batch, seq))
+            if sharding is not None:
+                toks = jax.device_put(toks, sharding)
+            model, opt_state, loss = step(model, opt_state, toks)
+            # keep the loss on device: a float() here would block a host
+            # round-trip into every step and serialize the dispatch queue
+            losses.append(loss)
+            if log_every and (i + 1) % log_every == 0:
+                logger.info("step %d loss %.4f", i + 1, float(loss))
+            if ckpt is not None and (
+                (i + 1) % every == 0 or (i + 1) == steps
+            ):
+                ckpt.save((model, opt_state), i + 1)
+    finally:
+        if ckpt is not None:
+            ckpt.close()
+    return model, [float(l) for l in losses]
+
+
+def synthetic_corpus(n: int, vocab: int, seed: int = 0) -> np.ndarray:
+    """A learnable-but-not-trivial token stream: an order-1 Markov chain
+    with a sparse, deterministic-ish transition structure."""
+    rng = np.random.default_rng(seed)
+    succ = rng.integers(0, vocab, size=(vocab, 4))
+    probs = np.array([0.7, 0.15, 0.1, 0.05])
+    out = np.empty(n, np.int32)
+    out[0] = 0
+    choices = rng.choice(4, size=n, p=probs)
+    for i in range(1, n):
+        out[i] = succ[out[i - 1], choices[i]]
+    return out

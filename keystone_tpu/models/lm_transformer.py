@@ -1,1092 +1,54 @@
-"""Decoder-only transformer LM with a fully sharded training step.
+"""Decoder-only transformer LM — stable import path and CLI.
+
+The implementation lives in :mod:`keystone_tpu.models.lm`
+(``model`` / ``train`` / ``decode``); this module re-exports that
+surface (existing imports and pickled checkpoints keep resolving here)
+and owns the config/CLI entry: ``python -m
+keystone_tpu.models.lm_transformer``.
 
 The reference has no sequence models at all (SURVEY §5: long-context
-"absent"), but long-context + distributed are first-class capabilities of
-this framework, not parity afterthoughts. This model is the training-side
-consumer of that stack:
-
-- causal attention via :mod:`keystone_tpu.ops.attention` — dense, fused
-  Pallas flash, or sequence-parallel ring / Ulysses (`seq_mode`), so one
-  flag takes the same model from a single chip to a sequence-sharded mesh
-  for contexts that don't fit one device;
-- tensor parallelism by sharding each weight over the mesh ``model`` axis
-  (head-parallel attention, column/row-parallel MLP, vocab-parallel tied
-  embedding) — XLA inserts the psums, the model code stays purely
-  functional;
-- data parallelism over the ``data`` axis;
-- one jitted, buffer-donated train step (AdamW via optax) — the whole
-  update is a single XLA program, the idiom the rest of the framework uses
-  for its solvers (one launch per step, no host round-trips).
-
-This is a beyond-reference capability in the same spirit as
-``models/vit_ridge.py``.
+"absent"); the LM is the training/serving-side consumer of the
+framework's sequence-parallel + pipeline-parallel + quantization stack —
+a beyond-reference capability in the spirit of ``models/vit_ridge.py``.
 """
 
 from __future__ import annotations
 
 import dataclasses
-import functools
-import math
 import time
 
 import jax
-import jax.numpy as jnp
 import numpy as np
-import optax
 
 from keystone_tpu.core.config import arg, parse_config
 from keystone_tpu.core.logging import get_logger
-from keystone_tpu.core.treenode import static_field, treenode
-from keystone_tpu.ops.attention import (
-    dense_attention,
-    ring_attention,
-    ulysses_attention,
+from keystone_tpu.models.lm import (  # noqa: F401  (re-exported surface)
+    KVCache,
+    LMBlock,
+    TransformerLM,
+    decode_step,
+    generate,
+    make_optimizer,
+    make_pp_train_step,
+    make_train_step,
+    next_token_loss,
+    next_token_loss_pp,
+    pp_forward,
+    prefill,
+    quantize_for_decode,
+    shard_params,
+    synthetic_corpus,
+    token_cross_entropy,
+    train,
+    train_step_flops,
 )
-from keystone_tpu.ops.quantization import QTensor, mm, quantize_int8
-from keystone_tpu.ops.vit import _layer_norm
+from keystone_tpu.models.lm.decode import _filter_logits  # noqa: F401
+from keystone_tpu.models.lm.model import (  # noqa: F401
+    has_quantized_leaves as _has_quantized_leaves,
+)
+from keystone_tpu.models.lm.train import _step_batch  # noqa: F401
 
 logger = get_logger("keystone_tpu.models.lm_transformer")
-
-
-@treenode
-class LMBlock:
-    wq: jnp.ndarray  # (d, d)
-    wk: jnp.ndarray
-    wv: jnp.ndarray
-    wo: jnp.ndarray
-    w1: jnp.ndarray  # (d, ff)
-    w2: jnp.ndarray  # (ff, d)
-
-
-def _ln(x, cdt):
-    # normalization stats in f32 even under a bf16 policy: the
-    # mean/variance cancellation is exactly what bf16 loses
-    return _layer_norm(x.astype(jnp.float32)).astype(cdt)
-
-
-def _split_heads(y, w, h):
-    n, s, _ = y.shape
-    out = mm(y, w, y.dtype)  # (n, s, h·hd) — rectangular for GQA K/V
-    return out.reshape(n, s, h, out.shape[-1] // h).transpose(0, 2, 1, 3)
-
-
-def _rope(x, positions, base: float = 10_000.0):
-    """Rotary position embedding. x: (..., S, hd), hd even; positions:
-    (S,) int32 global token positions. Angles in f32 (bf16 loses phase
-    accuracy fast at long context), rotated result back in x.dtype."""
-    hd = x.shape[-1]
-    half = hd // 2
-    inv = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
-    freqs = positions.astype(jnp.float32)[:, None] * inv  # (S, half)
-    cos, sin = jnp.cos(freqs), jnp.sin(freqs)
-    x1 = x[..., :half].astype(jnp.float32)
-    x2 = x[..., half:].astype(jnp.float32)
-    return jnp.concatenate(
-        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
-    ).astype(x.dtype)
-
-
-def _block_apply(x, blk: LMBlock, cdt, attn, moe=None):
-    """Pre-LN residual block shared by training forward, prefill, and
-    decode: ``attn(y, blk) -> (attention output (N,S,d), aux)``. When
-    ``moe`` is given it replaces the dense FFN; returns
-    (x, attn_aux, moe_aux_loss)."""
-    a, aux = attn(_ln(x, cdt), blk)
-    x = x + a
-    y = _ln(x, cdt)
-    if moe is not None:
-        f, moe_aux = moe(y)
-        return x + f, aux, moe_aux
-    hdn = mm(y, blk.w1, cdt)
-    return x + mm(jax.nn.gelu(hdn), blk.w2, cdt), aux, jnp.float32(0)
-
-
-def _gather_embed(embed, tokens):
-    """Embedding-row gather handling the int8 row-quantized table (the
-    per-token scales apply to the gathered rows)."""
-    if isinstance(embed, QTensor):
-        return embed.q[tokens].astype(jnp.float32) * embed.scale[tokens]
-    return embed[tokens]
-
-
-def _embed(model, tokens, cdt):
-    """Token embedding + optional learned positions, cast to the compute
-    dtype — the one preamble shared by training forward, prefill, and the
-    pipeline-parallel forward."""
-    d = model.embed.shape[-1]
-    x = _gather_embed(model.embed, tokens) * math.sqrt(d)
-    if model.pos_encoding == "learned":
-        x = x + model.pos_embed[: tokens.shape[1]]
-    return x.astype(cdt)
-
-
-def _tied_logits(x, embed, cdt):
-    # bf16 operands, f32 accumulate/output: the logits feed a logsumexp —
-    # bf16 logits would cost real perplexity precision
-    if isinstance(embed, QTensor):
-        # (V, 1) row scales become per-output-channel under the transpose
-        return jnp.matmul(
-            _ln(x, cdt), embed.q.T.astype(cdt),
-            preferred_element_type=jnp.float32,
-        ) * embed.scale[:, 0]
-    return jnp.matmul(
-        _ln(x, cdt), embed.T.astype(cdt), preferred_element_type=jnp.float32
-    )
-
-
-@treenode
-class TransformerLM:
-    """Pre-LN decoder-only LM; logits tied to the token embedding."""
-
-    embed: jnp.ndarray  # (V, d)
-    pos_embed: jnp.ndarray  # (S_max, d)
-    blocks: tuple  # of LMBlock
-    num_heads: int = static_field(default=8)
-    # attention strategy: "local" (dense or Pallas flash on TPU),
-    # "ring" / "ulysses" (sequence-parallel over `seq_axis` of `mesh`)
-    seq_mode: str = static_field(default="local")
-    mesh: object = static_field(default=None)
-    seq_axis: str = static_field(default="data")
-    # rematerialize each block in the backward pass: activation memory
-    # drops from O(depth · S · d) per-layer intermediates to the block
-    # boundaries only — the jax.checkpoint successor of the reference's
-    # nothing (it never trained deep models)
-    remat: bool = static_field(default=False)
-    # mixed precision: params/optimizer state stay float32; activations
-    # and the matmul operands run in this dtype ("bfloat16" halves HBM
-    # traffic and feeds the MXU its native input width). LayerNorm stats
-    # and the loss reduction stay float32 regardless.
-    compute_dtype: str = static_field(default="float32")
-    # expert parallelism: per-block MoE layers (None entries keep the
-    # dense FFN). Tuple parallel to `blocks`; empty = no MoE anywhere.
-    moe_layers: tuple = ()
-    moe_aux_weight: float = static_field(default=0.01)
-    # "learned" = trained absolute table (pos_embed, capped at max_seq);
-    # "rope" = rotary q/k phases — no table, no length cap beyond memory,
-    # the right pairing for the blockwise long-context backward
-    pos_encoding: str = static_field(default="learned")
-    # grouped-query attention: K/V carry this many heads (0 = num_heads,
-    # plain MHA; 1 = MQA). The decode cache shrinks by num_heads/kv_heads
-    # — composing with kv_dtype="int8" for the full serving story
-    num_kv_heads: int = static_field(default=0)
-
-    @property
-    def kv_heads(self) -> int:
-        return self.num_kv_heads or self.num_heads
-
-    def _qkv_heads(self, x, blk: LMBlock, positions=None):
-        """(q with H heads, k/v with KV heads, rope applied).
-        ``positions`` defaults to 0..S-1 (full-sequence forward); decode
-        passes the single global position of its new token."""
-        q = _split_heads(x, blk.wq, self.num_heads)
-        k = _split_heads(x, blk.wk, self.kv_heads)
-        v = _split_heads(x, blk.wv, self.kv_heads)
-        if self.pos_encoding == "rope":
-            if positions is None:
-                positions = jnp.arange(x.shape[1])
-            q = _rope(q, positions)
-            k = _rope(k, positions)
-        return q, k, v
-
-    def _attention(self, x, blk: LMBlock, return_kv: bool = False):
-        n, s, d = x.shape
-        h = self.num_heads
-
-        # x is always the full (global) sequence here — the
-        # sequence-parallel paths shard inside ring/ulysses_attention
-        q, k, v = self._qkv_heads(x, blk)
-        kv_raw = (k, v)  # pre-broadcast: what the decode cache stores
-        if self.kv_heads != h:
-            # training/prefill compute broadcasts K/V up to H heads
-            # (activation-sized, the standard GQA training treatment);
-            # the grouped decode path never materializes this
-            g = h // self.kv_heads
-            k = jnp.repeat(k, g, axis=1)
-            v = jnp.repeat(v, g, axis=1)
-        # sequence-parallel training runs the custom-VJP bodies: the ring
-        # backward circulates dk/dv accumulators around the ring (the
-        # per-hop Pallas forward kernels are forward-only), Ulysses
-        # differentiates the flash trainable wrapper through all_to_all.
-        # use_flash auto-selects: Pallas-rate on TPU, jnp off it.
-        if self.seq_mode == "ring":
-            out = ring_attention(
-                q, k, v, self.mesh, seq_axis=self.seq_axis, causal=True,
-                trainable=True,
-            )
-        elif self.seq_mode == "ulysses":
-            out = ulysses_attention(
-                q, k, v, self.mesh, seq_axis=self.seq_axis, causal=True,
-                trainable=True,
-            )
-        else:
-            from keystone_tpu.ops.flash_attention import on_tpu
-
-            if on_tpu():
-                # fused Pallas forward with a recompute VJP — training
-                # never materializes the (S, S) probabilities
-                from keystone_tpu.ops.flash_attention import (
-                    flash_attention_trainable,
-                )
-
-                out = flash_attention_trainable(q, k, v, True)
-            else:
-                out = dense_attention(q, k, v, causal=True)
-        proj = mm(
-            out.transpose(0, 2, 1, 3).reshape(n, s, d).astype(x.dtype),
-            blk.wo,
-            x.dtype,
-        )
-        if return_kv:
-            return proj, kv_raw
-        return proj
-
-    def _moe(self, i: int):
-        return self.moe_layers[i] if self.moe_layers else None
-
-    def __call__(self, tokens):
-        """(B, S) int tokens → (B, S, V) float32 logits."""
-        return self.forward_with_aux(tokens)[0]
-
-    def forward_with_aux(self, tokens):
-        """(logits (B, S, V) f32, total MoE load-balance aux loss)."""
-        cdt = jnp.dtype(self.compute_dtype)
-        x = _embed(self, tokens, cdt)
-
-        def block_fn(x, blk, moe):
-            out, _, moe_aux = _block_apply(
-                x, blk, cdt,
-                lambda y, b: (self._attention(y, b), None),
-                moe=moe,
-            )
-            return out, moe_aux
-
-        if self.remat:
-            block_fn = jax.checkpoint(block_fn)
-        aux = jnp.float32(0)
-        for i, blk in enumerate(self.blocks):
-            x, moe_aux = block_fn(x, blk, self._moe(i))
-            aux = aux + moe_aux
-        return _tied_logits(x, self.embed, cdt), aux
-
-    @staticmethod
-    def create(
-        key,
-        vocab: int = 256,
-        max_seq: int = 512,
-        dim: int = 256,
-        depth: int = 4,
-        num_heads: int = 8,
-        ff_mult: int = 4,
-        seq_mode: str = "local",
-        mesh=None,
-        seq_axis: str = "data",
-        compute_dtype: str = "float32",
-        moe_every: int = 0,
-        num_experts: int = 8,
-        capacity_factor: float = 1.25,
-        pos_encoding: str = "learned",
-        num_kv_heads: int = 0,
-    ) -> "TransformerLM":
-        """``moe_every=k`` replaces the dense FFN of every k-th block with
-        a top-2 routed :class:`~keystone_tpu.ops.moe.MoELayer` of
-        ``num_experts`` experts (0 = dense everywhere).
-        ``pos_encoding="rope"`` drops the learned table (and its max_seq
-        cap) for rotary q/k phases."""
-        if pos_encoding not in ("learned", "rope"):
-            raise ValueError(
-                f"pos_encoding={pos_encoding!r}; expected learned|rope"
-            )
-        if pos_encoding == "rope" and (dim // num_heads) % 2:
-            raise ValueError(
-                f"rope needs an even head dim; got dim/num_heads = "
-                f"{dim}/{num_heads} = {dim // num_heads}"
-            )
-        kvh = num_kv_heads or num_heads
-        if kvh <= 0 or num_heads % kvh:
-            raise ValueError(
-                f"num_heads={num_heads} not divisible by "
-                f"num_kv_heads={kvh}"
-            )
-        # canonical static field: 0 means MHA, so kvh == num_heads
-        # normalizes to 0 (num_kv_heads=H and =0 are the same model)
-        num_kv_heads = 0 if kvh == num_heads else kvh
-        kv_dim = kvh * (dim // num_heads)
-        # the split count and per-block stride must not depend on
-        # moe_every: dense models seeded before MoE existed must keep
-        # bit-identical weights, so MoE keys are folded in separately
-        keys = jax.random.split(key, 2 + 6 * depth)
-
-        def init(k, shape, fan_in):
-            return jax.random.normal(k, shape, jnp.float32) / math.sqrt(fan_in)
-
-        blocks = []
-        moes = []
-        for i in range(depth):
-            ks = keys[2 + 6 * i : 8 + 6 * i]
-            is_moe = bool(moe_every) and (i + 1) % moe_every == 0
-            blocks.append(
-                LMBlock(
-                    wq=init(ks[0], (dim, dim), dim),
-                    wk=init(ks[1], (dim, kv_dim), dim),
-                    wv=init(ks[2], (dim, kv_dim), dim),
-                    wo=init(ks[3], (dim, dim), dim),
-                    # a MoE block's dense FFN is never applied — zero-width
-                    # placeholders keep the pytree structure uniform
-                    # without dead parameters
-                    w1=jnp.zeros((dim, 0), jnp.float32)
-                    if is_moe
-                    else init(ks[4], (dim, ff_mult * dim), dim),
-                    w2=jnp.zeros((0, dim), jnp.float32)
-                    if is_moe
-                    else init(ks[5], (ff_mult * dim, dim), ff_mult * dim),
-                )
-            )
-            if is_moe:
-                from keystone_tpu.ops.moe import MoELayer
-
-                moes.append(
-                    MoELayer.create(
-                        jax.random.fold_in(key, 1_000_003 + i),
-                        dim, ff_mult * dim, num_experts, capacity_factor,
-                    )
-                )
-            else:
-                moes.append(None)
-        return TransformerLM(
-            embed=0.02 * jax.random.normal(keys[0], (vocab, dim)),
-            # rope keeps a zero-width placeholder: no table params, no cap
-            pos_embed=jnp.zeros((0, dim), jnp.float32)
-            if pos_encoding == "rope"
-            else 0.02 * jax.random.normal(keys[1], (max_seq, dim)),
-            blocks=tuple(blocks),
-            num_heads=num_heads,
-            seq_mode=seq_mode,
-            mesh=mesh,
-            seq_axis=seq_axis,
-            compute_dtype=compute_dtype,
-            moe_layers=tuple(moes) if moe_every else (),
-            pos_encoding=pos_encoding,
-            num_kv_heads=num_kv_heads,
-        )
-
-    def num_params(self) -> int:
-        return sum(
-            int(np.prod(leaf.shape)) for leaf in jax.tree_util.tree_leaves(self)
-        )
-
-
-def shard_params(model: TransformerLM, mesh) -> TransformerLM:
-    """Lay the weights out for tensor parallelism over the mesh ``model``
-    axis: attention q/k/v column-sharded (head-parallel) with wo
-    row-sharded, MLP column- then row-sharded, embedding vocab-sharded.
-    XLA then inserts exactly the two psums per block that hand-written
-    Megatron-style TP would — the layout IS the parallelism.
-    """
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    if mesh is None or mesh.shape.get("model", 1) == 1:
-        return model
-    n_model = mesh.shape["model"]
-
-    def put(x, spec):
-        # a dim not divisible by the axis (e.g. an unpadded vocab) is
-        # replicated rather than rejected
-        spec = P(
-            *(
-                a
-                if a is None or x.shape[i] % n_model == 0
-                else None
-                for i, a in enumerate(spec)
-            )
-        )
-        return jax.device_put(x, NamedSharding(mesh, spec))
-
-    blocks = tuple(
-        LMBlock(
-            wq=put(b.wq, P(None, "model")),
-            wk=put(b.wk, P(None, "model")),
-            wv=put(b.wv, P(None, "model")),
-            wo=put(b.wo, P("model", None)),
-            w1=put(b.w1, P(None, "model")),
-            w2=put(b.w2, P("model", None)),
-        )
-        for b in model.blocks
-    )
-    moes = tuple(
-        m
-        if m is None
-        else dataclasses.replace(
-            m,
-            # expert-parallel: one expert group per model-axis device;
-            # the router stays replicated (every token scores every
-            # expert) — XLA places the dispatch/combine all_to_alls
-            w_router=put(m.w_router, P()),
-            w1=put(m.w1, P("model", None, None)),
-            w2=put(m.w2, P("model", None, None)),
-        )
-        for m in model.moe_layers
-    )
-    return dataclasses.replace(
-        model,
-        embed=put(model.embed, P("model", None)),
-        pos_embed=put(model.pos_embed, P()),
-        blocks=blocks,
-        moe_layers=moes,
-    )
-
-
-@treenode
-class KVCache:
-    """Preallocated decode cache: static (L, B, KV_heads, S_max, hd)
-    buffers (KV_heads < num_heads under GQA — that ratio IS the cache
-    saving) plus the number of valid positions. Static shapes are the point — the whole
-    generate loop compiles to ONE program (prefill + a lax.scan of decode
-    steps) with in-place `dynamic_update_slice` writes, no retracing as
-    the sequence grows (the XLA analog of the reference's nothing: it has
-    no autoregressive models).
-
-    With ``kv_dtype="int8"`` the buffers hold per-position symmetric int8
-    with (L, B, H, S_max, 1) scales: at long context the cache, not the
-    weights, dominates each decode step's HBM reads, and the scales pull
-    OUT of both dots exactly (scores = (q·k_q^T)·scale_k; out =
-    (p·scale_v)·v_q), so nothing dequantized ever materializes."""
-
-    k: jnp.ndarray
-    v: jnp.ndarray
-    pos: jnp.ndarray  # scalar int32
-    k_scale: jnp.ndarray | None = None
-    v_scale: jnp.ndarray | None = None
-
-
-def _kv_quant(t):
-    """(..., hd) → (int8 codes, f32 scale (..., 1)) per-position — the
-    shared symmetric recipe pooling over the head dim."""
-    from keystone_tpu.ops.quantization import symmetric_int8
-
-    return symmetric_int8(t, (-1,))
-
-
-def prefill(model: TransformerLM, tokens, s_max: int,
-            kv_dtype: str | None = None):
-    """Run the prompt through the model once, capturing per-layer K/V into
-    an ``s_max``-long cache (optionally int8 — see :class:`KVCache`).
-    Returns (last-position logits (B, V), cache). Local attention only
-    (sequence-parallel decode shards the cache — use ring/Ulysses for
-    training, gather to local for decode)."""
-    if model.seq_mode != "local":
-        raise ValueError("prefill/decode require seq_mode='local'")
-    if kv_dtype not in (None, "int8"):
-        raise ValueError(f"kv_dtype={kv_dtype!r}; expected None|'int8'")
-    cdt = jnp.dtype(model.compute_dtype)
-    n, s = tokens.shape
-    x = _embed(model, tokens, cdt)
-
-    ks, vs = [], []
-    for i, blk in enumerate(model.blocks):
-        x, (k, v), _ = _block_apply(
-            x, blk, cdt,
-            lambda y, b: model._attention(y, b, return_kv=True),
-            moe=model._moe(i),
-        )
-        ks.append(k)
-        vs.append(v)
-    logits = _tied_logits(x[:, -1:], model.embed, cdt)[:, 0]
-    pad = [(0, 0), (0, 0), (0, s_max - s), (0, 0)]
-    k_stack = jnp.stack([jnp.pad(k, pad) for k in ks])
-    v_stack = jnp.stack([jnp.pad(v, pad) for v in vs])
-    if kv_dtype == "int8":
-        kq, ksc = _kv_quant(k_stack)
-        vq, vsc = _kv_quant(v_stack)
-        cache = KVCache(
-            k=kq, v=vq, pos=jnp.asarray(s, jnp.int32),
-            k_scale=ksc, v_scale=vsc,
-        )
-    else:
-        cache = KVCache(
-            k=k_stack, v=v_stack, pos=jnp.asarray(s, jnp.int32)
-        )
-    return logits, cache
-
-
-def decode_step(model: TransformerLM, token, cache: KVCache):
-    """One autoregressive step: (B,) token at position ``cache.pos`` →
-    ((B, V) logits, updated cache). Attention reads the full static-shape
-    cache with positions ≥ pos masked — compiler-friendly in exchange for
-    O(S_max) work per step."""
-    cdt = jnp.dtype(model.compute_dtype)
-    d = model.embed.shape[-1]
-    h = model.num_heads
-    hd = d // h
-    n = token.shape[0]
-    pos = cache.pos
-    x = _gather_embed(model.embed, token)[:, None] * math.sqrt(d)
-    if model.pos_encoding == "learned":
-        x = x + jax.lax.dynamic_slice_in_dim(model.pos_embed, pos, 1)
-    x = x.astype(cdt)
-
-    valid = (jnp.arange(cache.k.shape[3]) <= pos)[None, None, None, :]
-    quantized = cache.k_scale is not None
-    new_k, new_v = cache.k, cache.v
-    new_ks, new_vs = cache.k_scale, cache.v_scale
-
-    kvh = model.kv_heads
-    g = h // kvh  # query heads per K/V head (1 = plain MHA)
-
-    def cached_attn(i):
-        def attn(y, blk):
-            nonlocal new_k, new_v, new_ks, new_vs
-            # the shared split+rope helper, at the new token's global
-            # position; cached keys were stored rotated by prefill /
-            # earlier steps
-            q, k1, v1 = model._qkv_heads(y, blk, positions=pos[None])
-            if quantized:
-                k1, k1s = _kv_quant(k1)
-                v1, v1s = _kv_quant(v1)
-                new_ks = jax.lax.dynamic_update_slice(
-                    new_ks, k1s[None], (i, 0, 0, pos, 0)
-                )
-                new_vs = jax.lax.dynamic_update_slice(
-                    new_vs, v1s[None], (i, 0, 0, pos, 0)
-                )
-            # one 5-D in-place update per buffer — not gather + rewrite,
-            # which XLA may lower to an O(L·S_max) cache copy per layer
-            new_k = jax.lax.dynamic_update_slice(
-                new_k, k1[None].astype(new_k.dtype), (i, 0, 0, pos, 0)
-            )
-            new_v = jax.lax.dynamic_update_slice(
-                new_v, v1[None].astype(new_v.dtype), (i, 0, 0, pos, 0)
-            )
-            layer_k, layer_v = new_k[i], new_v[i]
-            # grouped attention (MHA is the g=1 special case): q heads
-            # regroup as (KV, G) against the KV-head cache — no repeated
-            # K/V ever materializes, which is GQA's decode point
-            qg = q.reshape(n, kvh, g, 1, hd).astype(cdt)
-            scores = jnp.einsum(
-                "bkgqd,bksd->bkgqs", qg, layer_k.astype(cdt),
-                preferred_element_type=jnp.float32,
-            ) / math.sqrt(hd)
-            if quantized:
-                # per-position scales pull out of the contraction exactly
-                scores = scores * new_ks[i][..., 0][:, :, None, None, :]
-            scores = jnp.where(valid[:, :, None], scores, -1e30)
-            probs = jax.nn.softmax(scores, axis=-1)
-            if quantized:
-                probs = probs * new_vs[i][..., 0][:, :, None, None, :]
-            out = jnp.einsum(
-                "bkgqs,bksd->bkgqd", probs.astype(cdt),
-                layer_v.astype(cdt),
-                preferred_element_type=jnp.float32,
-            )
-            proj = mm(
-                out.reshape(n, h, 1, hd).transpose(0, 2, 1, 3).reshape(
-                    n, 1, d
-                ).astype(cdt),
-                blk.wo,
-                cdt,
-            )
-            return proj, None
-
-        return attn
-
-    for i, blk in enumerate(model.blocks):
-        x, _, _ = _block_apply(x, blk, cdt, cached_attn(i), moe=model._moe(i))
-    logits = _tied_logits(x, model.embed, cdt)[:, 0]
-    # past-capacity poison: at pos >= S_max the cache write would clamp
-    # onto S_max-1 and return plausible-but-wrong logits; pos is traced,
-    # so the honest device-side failure is loud NaNs, not an exception
-    logits = jnp.where(pos < cache.k.shape[3], logits, jnp.nan)
-    return logits, KVCache(
-        k=new_k, v=new_v, pos=pos + 1, k_scale=new_ks, v_scale=new_vs
-    )
-
-
-def _filter_logits(logits, top_k: int, top_p: float):
-    """Top-k then nucleus filtering on (B, V) logits (already temperature
-    -scaled — the nucleus mass is meaningful only on the distribution
-    actually sampled): everything outside the keep-set drops to -inf.
-    Static-shape throughout, one descending sort shared by both filters.
-    """
-    v = logits.shape[-1]
-    sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
-    if top_k:
-        kth = sorted_l[:, top_k - 1][:, None]
-        logits = jnp.where(logits >= kth, logits, -jnp.inf)
-        # the nucleus below must see the top-k-filtered distribution
-        sorted_l = jnp.where(
-            jnp.arange(v)[None, :] < top_k, sorted_l, -jnp.inf
-        )
-    if top_p:
-        probs = jax.nn.softmax(sorted_l, axis=-1)
-        # exclusive cumulative mass BEFORE each token: a token stays while
-        # the mass above it is < top_p (the first token always stays)
-        csum = jnp.cumsum(probs, axis=-1) - probs
-        keep = csum < top_p
-        # smallest kept logit per row = the threshold
-        thresh = jnp.min(
-            jnp.where(keep, sorted_l, jnp.inf), axis=-1, keepdims=True
-        )
-        logits = jnp.where(logits >= thresh, logits, -jnp.inf)
-    return logits
-
-
-@functools.partial(
-    jax.jit,
-    static_argnames=("max_new", "temperature", "top_k", "top_p", "kv_dtype"),
-)
-def generate(
-    model: TransformerLM,
-    prompt,
-    *,
-    max_new: int,
-    temperature: float = 0.0,
-    top_k: int = 0,
-    top_p: float = 0.0,
-    kv_dtype: str | None = None,
-    key=None,
-):
-    """Greedy (temperature=0) or sampled decode of ``max_new`` tokens after
-    ``prompt`` (B, P). One jitted program: prefill + lax.scan over steps.
-    ``top_k``/``top_p`` (nucleus) restrict sampling to the head of the
-    distribution (0 = off; both compose); ``kv_dtype="int8"`` halves the
-    cache stream at long context (see :class:`KVCache`). Returns
-    (B, max_new) int32."""
-    if key is None:
-        key = jax.random.key(0)
-    s_max = prompt.shape[1] + max_new
-    if model.pos_encoding == "learned" and s_max > model.pos_embed.shape[0]:
-        raise ValueError(
-            f"prompt+max_new={s_max} exceeds max_seq={model.pos_embed.shape[0]}"
-        )
-    logits0, cache = prefill(model, prompt, s_max, kv_dtype=kv_dtype)
-
-    def pick(logits, k):
-        if temperature == 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        # temperature FIRST: the nucleus cut must measure mass on the
-        # distribution being sampled, not the unscaled one
-        logits = _filter_logits(logits / temperature, top_k, top_p)
-        return jax.random.categorical(k, logits).astype(jnp.int32)
-
-    keys = jax.random.split(key, max_new)
-    tok0 = pick(logits0, keys[0])
-
-    # scan max_new-1 steps: the token for step i is picked from step i-1's
-    # logits, so the final logits need no decode step of their own
-    def step(carry, k):
-        tok, cache = carry
-        logits, cache2 = decode_step(model, tok, cache)
-        tok2 = pick(logits, k)
-        return (tok2, cache2), tok2
-
-    if max_new == 1:
-        return tok0[:, None]
-    (_, _), rest = jax.lax.scan(step, (tok0, cache), keys[1:])
-    return jnp.concatenate([tok0[:, None], rest.T], axis=1)  # (B, max_new)
-
-
-def next_token_loss(model: TransformerLM, tokens) -> jnp.ndarray:
-    """Mean cross-entropy of predicting ``tokens[:, 1:]`` from the prefix
-    (the model runs on the first S tokens of an S+1 window), plus the
-    weighted MoE load-balance auxiliary when the model routes."""
-    logits, aux = model.forward_with_aux(tokens[:, :-1])
-    ce = token_cross_entropy(logits, tokens[:, 1:])
-    return ce + model.moe_aux_weight * aux
-
-
-def quantize_for_decode(model: TransformerLM) -> TransformerLM:
-    """Weight-only int8 quantization for serving: every block matrix gets
-    symmetric per-output-channel int8 (``ops/quantization.py``), the tied
-    embedding per-row scales (serving both the gather and the logit
-    transpose). Decode is HBM-bound — every step re-reads all params — so
-    halving the weight stream is the decode-rate lever on TPU. Inference
-    only: ``train`` rejects quantized models (gradients through rounding
-    are silently zero). MoE experts and pos_embed stay full precision
-    (experts want per-(expert, channel) scales; the table is tiny)."""
-
-    def qmat(w):
-        return quantize_int8(w) if w.size else w
-
-    blocks = tuple(
-        LMBlock(
-            wq=qmat(b.wq), wk=qmat(b.wk), wv=qmat(b.wv), wo=qmat(b.wo),
-            w1=qmat(b.w1), w2=qmat(b.w2),
-        )
-        for b in model.blocks
-    )
-    return dataclasses.replace(
-        model,
-        embed=quantize_int8(model.embed, channel_axis=0),
-        blocks=blocks,
-    )
-
-
-def _has_quantized_leaves(model) -> bool:
-    return any(
-        isinstance(l, QTensor)
-        for l in jax.tree_util.tree_leaves(
-            model, is_leaf=lambda x: isinstance(x, QTensor)
-        )
-    )
-
-
-def pp_forward(model: TransformerLM, tokens, mesh, *, n_micro: int,
-               axis: str = "model", data_axis: str | None = None):
-    """Pipeline-parallel forward: the block chain runs as GPipe stages
-    over the mesh ``axis`` (one group of ``depth/n_stages`` blocks per
-    device, microbatches streamed via ppermute —
-    :func:`keystone_tpu.parallel.pipeline_parallel.gpipe`), embedding and
-    tied logits replicated outside the pipe. Completes the LM's
-    parallelism matrix (dp × tp × sp × ep × pp). Dense blocks only (MoE
-    routing wants the expert axis, not the stage axis); parameters stay
-    replicated in HBM — pp here parallelizes compute, the memory story
-    is remat + the other axes.
-    """
-    if any(m is not None for m in model.moe_layers):
-        raise ValueError(
-            "pipeline-parallel path supports dense blocks only (route "
-            "experts over the model axis with moe_every instead)"
-        )
-    if model.seq_mode != "local":
-        raise ValueError(
-            "pipeline-parallel path requires seq_mode='local': the "
-            f"{model.seq_mode!r} attention opens its own shard_map, which "
-            "cannot nest inside the pipeline's"
-        )
-    n_stages = mesh.shape[axis]
-    depth = len(model.blocks)
-    if depth % n_stages:
-        raise ValueError(
-            f"depth {depth} not divisible by {n_stages} pipeline stages"
-        )
-    b = tokens.shape[0]
-    if b % n_micro:
-        raise ValueError(
-            f"batch {b} not divisible by n_micro={n_micro}"
-        )
-    per = depth // n_stages
-    cdt = jnp.dtype(model.compute_dtype)
-    x = _embed(model, tokens, cdt)
-    # pre-split microbatches HERE: gpipe's n_micro reshape heuristic is
-    # ambiguous when B == n_micro (it would mistake (B, S, d) for an
-    # already-microbatched (n_micro, S, d))
-    x = x.reshape(n_micro, b // n_micro, *x.shape[1:])
-
-    # stack the per-block pytrees: leading axis depth → (stages, per)
-    stacked = jax.tree_util.tree_map(
-        lambda *leaves: jnp.stack(leaves), *model.blocks
-    )
-    stacked = jax.tree_util.tree_map(
-        lambda l: l.reshape(n_stages, per, *l.shape[1:]), stacked
-    )
-
-    def stage_fn(stage_params, act):
-        for j in range(per):
-            blk = jax.tree_util.tree_map(lambda l: l[j], stage_params)
-            act = _block_apply(
-                act, blk, cdt,
-                lambda y, bb: (model._attention(y, bb), None),
-            )[0]
-        return act
-
-    if model.remat:
-        stage_fn = jax.checkpoint(stage_fn)
-    from keystone_tpu.parallel.pipeline_parallel import gpipe
-
-    out = gpipe(stage_fn, stacked, x, mesh, axis=axis, data_axis=data_axis)
-    out = out.reshape(b, *out.shape[2:])
-    return _tied_logits(out, model.embed, cdt)
-
-
-def next_token_loss_pp(model: TransformerLM, tokens, mesh, *,
-                       n_micro: int, axis: str = "model",
-                       data_axis: str | None = None) -> jnp.ndarray:
-    """Next-token CE through the GPipe forward (differentiable: scan,
-    ppermute, and psum all have transposes — the backward is the reverse
-    pipeline schedule, derived by AD rather than hand-scheduled)."""
-    logits = pp_forward(
-        model, tokens[:, :-1], mesh, n_micro=n_micro, axis=axis,
-        data_axis=data_axis,
-    )
-    return token_cross_entropy(logits, tokens[:, 1:])
-
-
-def make_pp_train_step(optimizer, mesh, *, n_micro: int,
-                       axis: str = "model",
-                       data_axis: str | None = None):
-    """Buffer-donated jitted pipeline-parallel train step. ``data_axis``
-    composes dp × pp: each data-row of devices pipelines its own batch
-    slice (grad psums across rows come from XLA's sharding propagation —
-    params are replicated over the data axis)."""
-
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def step(model, opt_state, tokens):
-        loss, grads = jax.value_and_grad(
-            lambda m, t: next_token_loss_pp(
-                m, t, mesh, n_micro=n_micro, axis=axis,
-                data_axis=data_axis,
-            )
-        )(model, tokens)
-        updates, opt_state = optimizer.update(
-            grads, opt_state, params=model
-        )
-        model = optax.apply_updates(model, updates)
-        return model, opt_state, loss
-
-    return step
-
-
-def make_train_step(optimizer):
-    """One buffer-donated jitted program: grads + AdamW update + loss."""
-
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def step(model, opt_state, tokens):
-        loss, grads = jax.value_and_grad(next_token_loss)(model, tokens)
-        updates, opt_state = optimizer.update(
-            grads, opt_state, params=model
-        )
-        model = optax.apply_updates(model, updates)
-        return model, opt_state, loss
-
-    return step
-
-
-def token_cross_entropy(logits, targets) -> jnp.ndarray:
-    """Mean next-token cross-entropy. logits: (B, S, V) f32; targets:
-    (B, S) int. The single source of the numerically sensitive
-    ``logsumexp - gold`` form, shared by training loss and evaluation."""
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(logz - gold)
-
-
-def _step_batch(corpus, seed: int, i: int, batch: int, seq: int):
-    """Step ``i``'s token windows, derived from ``(seed, i)`` alone — no
-    sequential RNG state, so a resumed run regenerates the exact batch
-    sequence an uninterrupted run would have seen."""
-    rng = np.random.default_rng(np.random.SeedSequence((seed, i)))
-    starts = rng.integers(0, len(corpus) - seq - 1, size=batch)
-    return np.stack([corpus[s : s + seq + 1] for s in starts])
-
-
-def make_optimizer(
-    lr: float,
-    *,
-    steps: int = 0,
-    schedule: str = "constant",
-    warmup_frac: float = 0.05,
-    grad_clip: float = 0.0,
-    weight_decay: float = 0.01,
-):
-    """The LM training optimizer: AdamW, optionally behind global-norm
-    gradient clipping, with a constant or warmup-cosine learning rate.
-    ``schedule="cosine"`` warms up over ``warmup_frac`` of ``steps`` and
-    decays to lr/10 — the standard LM recipe."""
-    if schedule not in ("constant", "cosine"):
-        raise ValueError(
-            f"schedule={schedule!r}; expected constant|cosine"
-        )
-    if schedule == "cosine":
-        if steps <= 0:
-            raise ValueError("schedule='cosine' needs the total steps")
-        lr = optax.warmup_cosine_decay_schedule(
-            init_value=0.0,
-            peak_value=lr,
-            warmup_steps=max(1, int(steps * warmup_frac)),
-            decay_steps=steps,
-            end_value=lr / 10.0,
-        )
-    opt = optax.adamw(lr, weight_decay=weight_decay)
-    if grad_clip > 0.0:
-        opt = optax.chain(optax.clip_by_global_norm(grad_clip), opt)
-    return opt
-
-
-def train(
-    model: TransformerLM,
-    corpus: np.ndarray,
-    *,
-    steps: int,
-    batch: int,
-    seq: int,
-    lr: float = 3e-4,
-    mesh=None,
-    seed: int = 0,
-    log_every: int = 0,
-    checkpoint_dir: str = "",
-    checkpoint_every: int = 0,
-    schedule: str = "constant",
-    grad_clip: float = 0.0,
-):
-    """Train on random windows of ``corpus`` (1-D int array). Returns
-    (model, losses). Batches are dp-sharded over the mesh ``data`` axis
-    unless the model is sequence-parallel (then S is the sharded axis and
-    the batch is replicated).
-
-    ``checkpoint_dir`` makes the run preemption-safe: model + optimizer
-    state are orbax-checkpointed every ``checkpoint_every`` steps (default
-    0 = ``steps // 10``, ~10 checkpoints per run), and a rerun with the
-    same arguments
-    resumes from the last completed step on the *identical* trajectory —
-    batches are derived per-step from ``(seed, i)``, not from sequential
-    RNG state (the LM analog of the solvers' ``resumable_fit``). ``losses``
-    covers only the steps this invocation ran. Note: ``schedule="cosine"``
-    derives its decay horizon from THIS invocation's ``steps`` — resuming
-    with a longer schedule is allowed (steps are not run identity) but
-    stretches the cosine rather than replaying the original horizon.
-    """
-    from keystone_tpu.parallel.mesh import data_sharding
-
-    if len(corpus) < seq + 2:
-        raise ValueError(
-            f"corpus of {len(corpus)} tokens is too short for seq={seq} "
-            f"(needs at least seq+2 = {seq + 2}); shorten --seq or grow "
-            "the corpus"
-        )
-    if _has_quantized_leaves(model):
-        raise ValueError(
-            "model holds int8 QTensor weights (quantize_for_decode is "
-            "inference-only) — gradients through the rounding would be "
-            "silently zero; train the float model and re-quantize"
-        )
-    optimizer = make_optimizer(
-        lr, steps=steps, schedule=schedule, grad_clip=grad_clip
-    )
-    opt_state = optimizer.init(model)
-    step = make_train_step(optimizer)
-    losses = []
-    sharding = None
-    if (
-        mesh is not None
-        and model.seq_mode == "local"
-        and batch % mesh.shape.get("data", 1) == 0
-    ):
-        sharding = data_sharding(mesh, ndim=2)
-
-    ckpt = None
-    start = 0
-    if checkpoint_dir:
-        import hashlib
-
-        from keystone_tpu.core.checkpoint import TrainCheckpointer
-
-        # default cadence: ~10 checkpoints per run, not one per step — a
-        # jitted LM step is milliseconds while a synchronous full-state
-        # orbax save is not (resumable_fit's every=1 default amortizes
-        # over whole BCD passes, a much coarser unit)
-        every = checkpoint_every or max(steps // 10, 1)
-        corpus_head = np.asarray(corpus[:64], np.int64)
-        ckpt = TrainCheckpointer(
-            checkpoint_dir,
-            # `steps` is deliberately absent (resuming with a longer
-            # schedule is the point — the over-trained guard below covers
-            # the short case), mirroring resumable_fit's num_iter rule.
-            # Everything else that shapes the trajectory is here: a
-            # param-shape match alone would silently accept a different
-            # model function (num_heads, dtype policy, seq_mode...)
-            {
-                "kind": "lm_transformer",
-                "batch": batch,
-                "seq": seq,
-                "lr": lr,
-                "seed": seed,
-                "schedule": schedule,
-                "grad_clip": grad_clip,
-                "num_heads": model.num_heads,
-                # normalized (kv_heads, never the 0 alias) so MHA spelled
-                # either way compares equal
-                "num_kv_heads": model.kv_heads,
-                "seq_mode": model.seq_mode,
-                "compute_dtype": model.compute_dtype,
-                "pos_encoding": model.pos_encoding,
-                "remat": model.remat,
-                "moe_aux_weight": model.moe_aux_weight,
-                "moe_experts": [
-                    None if m is None else m.num_experts
-                    for m in model.moe_layers
-                ],
-                "moe_capacity": [
-                    None if m is None else m.capacity_factor
-                    for m in model.moe_layers
-                ],
-                "corpus_len": int(len(corpus)),
-                "corpus_head_sha": hashlib.sha256(
-                    corpus_head.tobytes()
-                ).hexdigest()[:16],
-                "param_shapes": [
-                    list(map(int, leaf.shape))
-                    for leaf in jax.tree_util.tree_leaves(model)
-                ],
-            },
-            # keys added after checkpoints already existed in the wild:
-            # an older sidecar without them must compare as the value the
-            # code used at the time, not brick the resume
-            legacy_defaults={
-                "pos_encoding": "learned",
-                "schedule": "constant",
-                "grad_clip": 0.0,
-                # pre-GQA checkpoints were all MHA
-                "num_kv_heads": model.num_heads,
-            },
-        )
-    try:
-        if ckpt is not None:
-            (model, opt_state), start = ckpt.restore((model, opt_state))
-            if start > steps:
-                raise ValueError(
-                    f"{checkpoint_dir} holds a step-{start} checkpoint but "
-                    f"this run is only {steps} steps — refusing to return "
-                    "an over-trained model; point at a fresh directory"
-                )
-        for i in range(start, steps):
-            toks = jnp.asarray(_step_batch(corpus, seed, i, batch, seq))
-            if sharding is not None:
-                toks = jax.device_put(toks, sharding)
-            model, opt_state, loss = step(model, opt_state, toks)
-            # keep the loss on device: a float() here would block a host
-            # round-trip into every step and serialize the dispatch queue
-            losses.append(loss)
-            if log_every and (i + 1) % log_every == 0:
-                logger.info("step %d loss %.4f", i + 1, float(loss))
-            if ckpt is not None and (
-                (i + 1) % every == 0 or (i + 1) == steps
-            ):
-                ckpt.save((model, opt_state), i + 1)
-    finally:
-        if ckpt is not None:
-            ckpt.close()
-    return model, [float(l) for l in losses]
-
-
-def train_step_flops(model: TransformerLM, batch: int, seq: int) -> float:
-    """Analytic FLOPs of one train step: ~6·P_active·tokens for the matmul
-    work plus the attention score/value terms (12·L·d·S²·B fwd+bwd). MoE
-    expert gemms execute over ALL E·C static capacity slots (drops included
-    — that's the static-shape trade), so expert params count at C/G weight,
-    not the idealized 2/E."""
-    p = model.num_params()
-    tokens = batch * seq
-    for m in model.moe_layers:
-        if m is not None:
-            expert_p = int(np.prod(m.w1.shape)) + int(np.prod(m.w2.shape))
-            slots = m.num_experts * m._capacity(tokens)
-            p -= expert_p * (1.0 - min(slots / (tokens * m.num_experts), 1.0))
-    d = model.embed.shape[-1]
-    attn = 12 * len(model.blocks) * d * seq * seq * batch
-    return 6.0 * p * tokens + attn
-
-
-def synthetic_corpus(n: int, vocab: int, seed: int = 0) -> np.ndarray:
-    """A learnable-but-not-trivial token stream: an order-1 Markov chain
-    with a sparse, deterministic-ish transition structure."""
-    rng = np.random.default_rng(seed)
-    succ = rng.integers(0, vocab, size=(vocab, 4))
-    probs = np.array([0.7, 0.15, 0.1, 0.05])
-    out = np.empty(n, np.int32)
-    out[0] = 0
-    choices = rng.choice(4, size=n, p=probs)
-    for i in range(1, n):
-        out[i] = succ[out[i - 1], choices[i]]
-    return out
 
 
 @dataclasses.dataclass
