@@ -252,6 +252,21 @@ def main() -> None:
             n_w / sec, 1
         )
 
+    # ---- ImageNet-shaped weighted solver (d=4096 blocks, C=1000) ----
+    # the shape the Woodbury redesign targets (VERDICT r3 weak #5);
+    # problem + cost model live in bench.weighted_imagenet_problem.
+    # TPU-only like bench.py's gate: the ~2 PFLOP fit is hours of host
+    # BLAS under a JAX_PLATFORMS=cpu pin
+    if dev.platform != "cpu":
+        from bench import weighted_imagenet_problem
+
+        ai, yi, est_i, wi_flops = weighted_imagenet_problem()
+        sec = _timed(lambda: est_i.fit(ai, yi), iters=1)
+        record("weighted_imagenet_bf16pass", sec, wi_flops)
+        out["phases"]["weighted_imagenet_bf16pass"]["samples_per_s"] = (
+            round(int(ai.shape[0]) / sec, 1)
+        )
+
     path = os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "MFU_SWEEP.json",
