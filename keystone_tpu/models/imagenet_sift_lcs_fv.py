@@ -65,6 +65,14 @@ class ImageNetConfig:
     seed: int = arg(default=0)
     synthetic: int = arg(default=0, help="if > 0, N synthetic images")
     synthetic_classes: int = arg(default=8)
+    label_noise: float = arg(
+        default=0.0,
+        help="fraction q of synthetic images rendered from a random OTHER "
+        "class's center while keeping their label: a provable top-1 error "
+        "floor of exactly q (flips never land on the labeled class), so a "
+        "scale eval can assert a nonzero target band in both directions "
+        "(an eval reading 0.000 cannot detect a quality regression)",
+    )
     streaming: bool = arg(
         default=False,
         help="two-pass streaming ingestion: never materializes the image "
@@ -73,17 +81,39 @@ class ImageNetConfig:
     stream_batch: int = arg(default=256, help="host images per stream batch")
 
 
+def _synthetic_centers(k: int) -> np.ndarray:
+    """The (k, 8, 8, 3) class centers every synthetic path shares (eager
+    load, streaming source, and the calibration test in
+    tests/test_streaming.py)."""
+    return np.random.default_rng(42).normal(
+        loc=128, scale=30, size=(k, 8, 8, 3)
+    )
+
+
+def _render_classes(labels, k: int, q: float, rng) -> np.ndarray:
+    """Class index each synthetic image is RENDERED from: with
+    probability ``q`` a uniformly random OTHER class, while the label
+    stays. Because a flip never lands back on the labeled class, the
+    top-1 error floor is exactly ``q`` — the calibrated overlap behind
+    ``label_noise``."""
+    render = labels.copy()
+    if q and k > 1:
+        flip = rng.random(len(labels)) < q
+        other = (labels + rng.integers(1, k, size=len(labels))) % k
+        render[flip] = other[flip]
+    return render
+
+
 def _load(conf: ImageNetConfig, which: str) -> tuple[LabeledImages, int]:
     if conf.synthetic:
         k = conf.synthetic_classes
         n = conf.synthetic if which == "train" else max(conf.synthetic // 4, 1)
         rng = np.random.default_rng(0 if which == "train" else 1)
         labels = rng.integers(0, k, size=n).astype(np.int32)
-        centers = np.random.default_rng(42).normal(
-            loc=128, scale=30, size=(k, 8, 8, 3)
-        )
+        centers = _synthetic_centers(k)
+        render = _render_classes(labels, k, conf.label_noise, rng)
         imgs = np.kron(
-            centers[labels],
+            centers[render],
             np.ones((1, conf.image_size // 8, conf.image_size // 8, 1)),
         )
         imgs += rng.normal(scale=20, size=imgs.shape)
@@ -149,9 +179,7 @@ def _synthetic_source(conf: ImageNetConfig, which: str):
     k = conf.synthetic_classes
     n = conf.synthetic if which == "train" else max(conf.synthetic // 4, 1)
     seed = 0 if which == "train" else 1
-    centers = np.random.default_rng(42).normal(
-        loc=128, scale=30, size=(k, 8, 8, 3)
-    )
+    centers = _synthetic_centers(k)
     up = conf.image_size // 8
 
     def source():
@@ -159,7 +187,8 @@ def _synthetic_source(conf: ImageNetConfig, which: str):
             b = min(conf.stream_batch, n - s)
             rng = np.random.default_rng((seed, s))
             labels = rng.integers(0, k, size=b).astype(np.int32)
-            imgs = np.kron(centers[labels], np.ones((1, up, up, 1)))
+            render = _render_classes(labels, k, conf.label_noise, rng)
+            imgs = np.kron(centers[render], np.ones((1, up, up, 1)))
             imgs += rng.normal(scale=20, size=imgs.shape)
             yield np.clip(imgs, 0, 255).astype(np.float32), labels
 

@@ -175,6 +175,62 @@ def test_imagenet_streaming_matches_eager_shape(mesh8):
     assert 0.0 <= res["test_top5_error"] <= 1.0
 
 
+def test_synthetic_label_noise_calibration():
+    """``label_noise=q`` renders ~q of images from a wrong class's center
+    while keeping labels — the floor the scale eval's error band rests
+    on. Verified by nearest-center classification in pixel space (noise
+    scale 20 ≪ center separation, so mismatch fraction ≈ q)."""
+    from keystone_tpu.models import imagenet_sift_lcs_fv as m
+
+    k, n, q = 4, 512, 0.3
+    from keystone_tpu.models.imagenet_sift_lcs_fv import _synthetic_centers
+
+    centers = _synthetic_centers(k)
+
+    def mismatch_frac(noise):
+        conf = m.ImageNetConfig(
+            synthetic=n, synthetic_classes=k, image_size=32,
+            stream_batch=128, label_noise=noise,
+        )
+        mism = tot = 0
+        for imgs, labels in m._synthetic_source(conf, "train")():
+            b = len(labels)
+            down = imgs.reshape(b, 8, 4, 8, 4, 3).mean((2, 4))
+            d2 = ((down[:, None] - centers[None]) ** 2).sum((2, 3, 4))
+            mism += int((np.argmin(d2, axis=1) != labels).sum())
+            tot += b
+        assert tot == n
+        return mism / tot
+
+    assert mismatch_frac(0.0) <= 0.02
+    frac = mismatch_frac(q)
+    # binomial sd at n=512 is ~0.02; ±4σ band around q
+    assert 0.22 <= frac <= 0.38, frac
+
+
+def test_imagenet_streaming_label_noise_raises_error(mesh8):
+    """The e2e streaming pipeline's measured error moves with the
+    calibrated overlap: a heavily mixed corpus cannot score ~0, and the
+    clean corpus must stay better than the mixed one (the property the
+    100k artifact's band assertion relies on)."""
+    from keystone_tpu.models import imagenet_sift_lcs_fv as m
+
+    def run(noise):
+        conf = m.ImageNetConfig(
+            synthetic=256, synthetic_classes=4, num_classes=4,
+            image_size=32, desc_dim=8, vocab_size=2,
+            num_pca_samples=2000, num_gmm_samples=2000, chunk_size=8,
+            block_size=256, sift_scales=1, lcs_stride=8, lcs_border=8,
+            lam=1e-3, label_noise=noise,
+        )
+        return m.run_streaming(conf, mesh=None)
+
+    clean = run(0.0)
+    mixed = run(0.6)  # floor = q = 0.6 exactly
+    assert mixed["test_top1_error"] >= clean["test_top1_error"]
+    assert mixed["test_top1_error"] >= 0.2
+
+
 REF = "/root/reference/src/test/resources"
 
 

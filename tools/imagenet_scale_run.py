@@ -48,6 +48,16 @@ def main(argv=None) -> dict:
     ap.add_argument("--sift-scales", type=int, default=5)
     ap.add_argument("--num-iter", type=int, default=1)
     ap.add_argument(
+        "--label-noise",
+        type=float,
+        default=0.25,
+        help="fraction of images rendered from a wrong class's center "
+        "(top-1 error floor = exactly q, see ImageNetConfig.label_noise); "
+        "the full-scale run asserts test top-1 error inside the band below",
+    )
+    ap.add_argument("--band-lo", type=float, default=0.20)
+    ap.add_argument("--band-hi", type=float, default=0.40)
+    ap.add_argument(
         "--out",
         default=os.path.join(
             os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -55,6 +65,25 @@ def main(argv=None) -> dict:
         ),
     )
     args = ap.parse_args(argv)
+    # the floor IS q (flips never land on the labeled class); reject a
+    # misconfigured band BEFORE the multi-hour run. The band must
+    # contain the floor: band_hi below it means every run fails no
+    # matter the model; band_lo above it means a well-fit model (whose
+    # error sits at the floor) fails the lower gate.
+    if args.label_noise > 0:
+        if args.label_noise > args.band_hi:
+            ap.error(
+                f"--label-noise {args.label_noise} (= the top-1 error "
+                f"floor) exceeds --band-hi {args.band_hi}: every run "
+                "would fail the gate regardless of model quality"
+            )
+        if args.label_noise < args.band_lo:
+            ap.error(
+                f"--label-noise {args.label_noise} (= the top-1 error "
+                f"floor) is below --band-lo {args.band_lo}: a well-fit "
+                "model scores ~the floor and would fail the lower gate; "
+                "lower --band-lo or raise --label-noise"
+            )
 
     sys.path.insert(
         0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -83,6 +112,7 @@ def main(argv=None) -> dict:
         num_iter=args.num_iter,
         stream_batch=args.stream_batch,
         chunk_size=args.chunk_size,
+        label_noise=args.label_noise,
         streaming=True,
         # bounded reservoirs: default 10M rows x desc_dim would be fine,
         # but cap to keep host RSS well under the image-stream footprint
@@ -120,9 +150,30 @@ def main(argv=None) -> dict:
             text=True,
         ).stdout.strip(),
     }
+    # calibrated-overlap gate (VERDICT r3 #5): the label-noise floor is
+    # exactly q, so at the defaults test top-1 must sit INSIDE
+    # [band_lo, band_hi] — too high = quality regression, ~0.000 = the
+    # eval can no longer fail and is itself broken. Only asserted at
+    # ≥50k images (below that the ~q·N_test per-class statistics are too
+    # thin for a tight band); smaller runs record the band untested.
+    floor = args.label_noise
+    artifact["label_noise"] = args.label_noise
+    artifact["error_floor_expected"] = round(floor, 4)
+    artifact["error_band"] = [args.band_lo, args.band_hi]
+    gate = args.label_noise > 0 and args.num_images >= 50_000
+    band_ok = args.band_lo <= result["test_top1_error"] <= args.band_hi
+    artifact["band_asserted"] = gate
+    artifact["band_ok"] = band_ok
     with open(args.out, "w") as f:
         json.dump(artifact, f, indent=1)
     print(json.dumps(artifact))
+    if gate and not band_ok:
+        print(
+            f"FAIL: test_top1_error={result['test_top1_error']:.4f} outside "
+            f"[{args.band_lo}, {args.band_hi}] (floor {floor:.3f})",
+            file=sys.stderr,
+        )
+        sys.exit(4)
     return artifact
 
 
