@@ -132,6 +132,11 @@ def gpipe(
             )
     pspec = P(axis)
     xspec = P(None, data_axis) if data_axis is not None else P()
+    # only the pipeline (and optional dp) axes go manual: any OTHER mesh
+    # axis stays automatic, so tensor-parallel weight shardings propagate
+    # INTO the stage bodies and XLA places their psums — pp x dp x tp
+    # composes on a 3-axis mesh with no pipeline-code knowledge of tp
+    manual = {axis} | ({data_axis} if data_axis is not None else set())
     fn = jax.shard_map(
         partial(
             _pipeline_shard,
@@ -145,6 +150,7 @@ def gpipe(
             xspec,
         ),
         out_specs=xspec,
+        axis_names=frozenset(manual),
     )
     out = fn(stacked_params, x)
     if reshaped:

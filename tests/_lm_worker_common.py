@@ -6,7 +6,8 @@ BATCH, SEQ, STEPS_LM = 8, 32, 3
 LR, VOCAB, DIM, DEPTH, HEADS = 1e-3, 31, 32, 2, 2
 
 
-def build(key_seed: int = 0):
+def build(key_seed: int = 0, *, dim: int = DIM, depth: int = DEPTH,
+          num_heads: int = HEADS):
     """(model, optimizer, train_step, corpus) with the canonical tiny
     hyperparams. Import jax lazily so workers can pin their platform env
     before anything touches the backend."""
@@ -16,8 +17,8 @@ def build(key_seed: int = 0):
     from keystone_tpu.models import lm_transformer as lm
 
     model = lm.TransformerLM.create(
-        jax.random.key(key_seed), vocab=VOCAB, max_seq=SEQ, dim=DIM,
-        depth=DEPTH, num_heads=HEADS,
+        jax.random.key(key_seed), vocab=VOCAB, max_seq=SEQ, dim=dim,
+        depth=depth, num_heads=num_heads,
     )
     optimizer = optax.adamw(LR)
     step = lm.make_train_step(optimizer)
@@ -29,3 +30,17 @@ def step_batch(corpus, i: int):
     from keystone_tpu.models import lm_transformer as lm
 
     return lm._step_batch(corpus, 0, i, BATCH, SEQ)
+
+
+# canonical shapes for the 4-process tp/pp workers: dim divisible by a
+# 4-way model axis (one head per shard), depth divisible by 4 stages
+DIM_TP, DEPTH_TP, HEADS_TP = 32, 4, 4
+
+
+def build_tp(key_seed: int = 0):
+    """:func:`build` at the cross-process tensor/pipeline-parallel
+    shapes — one shared recipe, so the worker and its single-process
+    reference cannot drift."""
+    return build(
+        key_seed, dim=DIM_TP, depth=DEPTH_TP, num_heads=HEADS_TP
+    )

@@ -1,0 +1,125 @@
+"""Worker for the 4-process tensor/pipeline-parallel multihost tests.
+
+The round-3 multihost suite stopped at 2 processes with the model axis
+INSIDE a process; here the interesting layouts actually happen: 4
+processes x 2 local devices form a (data=2, model=4) mesh whose model
+axis spans the process boundary, so
+
+- tensor-parallel weight shards live on devices of DIFFERENT processes
+  and every block's two psums cross gloo;
+- the GPipe stage chain's ppermute hops cross gloo mid-pipeline;
+- each data row spans two processes, so two processes contribute the
+  SAME batch shard via ``make_array_from_process_local_data``.
+
+Results must equal single-process training/forward exactly (the parity
+the reference gets from deterministic Spark lineage,
+``bin/run-pipeline.sh:16-26``).
+
+Usage: python multihost_tp_worker.py <process_id> <num_processes> <port> <out>
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from _lm_worker_common import (  # noqa: E402
+    BATCH,
+    SEQ,
+    STEPS_LM as STEPS,
+    build_tp,
+    step_batch,
+)
+
+
+def main() -> None:
+    pid, nprocs, port, out_path = (
+        int(sys.argv[1]),
+        int(sys.argv[2]),
+        sys.argv[3],
+        sys.argv[4],
+    )
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from keystone_tpu.models import lm_transformer as lm
+    from keystone_tpu.parallel import multihost
+    from keystone_tpu.parallel.mesh import create_mesh
+
+    multihost.initialize(
+        coordinator_address=f"localhost:{port}",
+        num_processes=nprocs,
+        process_id=pid,
+    )
+    assert jax.device_count() == 2 * nprocs
+    mesh = create_mesh(data=2, model=4)
+
+    # the model axis must actually cross a process boundary — otherwise
+    # this test silently degenerates to the round-3 coverage
+    col_procs = {
+        d.process_index for d in mesh.devices[0]  # one model group
+    }
+    assert len(col_procs) > 1, f"model axis within one process: {col_procs}"
+
+    def host_full(x):
+        """Gather a (possibly cross-process-sharded) global array to
+        host: re-lay it out fully replicated, then read locally."""
+        rep = jax.jit(
+            lambda a: a, out_shardings=NamedSharding(mesh, P())
+        )(x)
+        return np.asarray(rep)
+
+    # ---- dp x tp training: grads psum over gloo through the tp axis ----
+    model, optimizer, step, corpus = build_tp()
+    model = lm.shard_params(model, mesh)
+    opt_state = optimizer.init(model)
+
+    # which data row this process's devices sit on (each row spans TWO
+    # processes; both contribute the same shard of the batch)
+    rows = {
+        int(np.argwhere(mesh.devices == d)[0][0])
+        for d in jax.local_devices()
+    }
+    assert len(rows) == 1, rows
+    row = rows.pop()
+    lo, hi = row * BATCH // 2, (row + 1) * BATCH // 2
+
+    losses = []
+    for i in range(STEPS):
+        toks = step_batch(corpus, i)
+        g_toks = multihost.global_batch_from_local(
+            np.ascontiguousarray(toks[lo:hi]), mesh
+        )
+        assert g_toks.shape == (BATCH, SEQ + 1), g_toks.shape
+        model, opt_state, loss = step(model, opt_state, g_toks)
+        losses.append(float(loss))
+
+    wq = host_full(model.blocks[0].wq)
+    embed = host_full(model.embed)
+
+    # ---- GPipe forward with stages spanning processes (dp x pp) ----
+    model2, _, _, _ = build_tp()
+    toks_pp = step_batch(corpus, 99)[:, :SEQ].astype(np.int32)
+    pp_logits = lm.pp_forward(
+        model2, toks_pp, mesh, n_micro=2, axis="model", data_axis="data"
+    )
+    pp = host_full(pp_logits)
+
+    if pid == 0:
+        np.savez(
+            out_path,
+            losses=np.asarray(losses, np.float64),
+            wq=wq,
+            embed=embed,
+            pp=pp,
+        )
+    print(f"worker {pid}: ok", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -464,6 +464,51 @@ def test_pp_dp_composed_shards_batch(mesh4x2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
 
 
+def test_pp_dp_tp_three_axis_composition(devices):
+    """pp x dp x tp on a 3-axis mesh: stages manual over `pipe`,
+    microbatch batch-dim manual over `data`, and the `model` axis left
+    AUTO so the tp weight layout propagates INTO the stage bodies (the
+    gpipe partial-manual shard_map). Loss and updated params must match
+    the plain local train step."""
+    import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(
+        np.asarray(jax.devices()[:8]).reshape(2, 2, 2),
+        ("pipe", "data", "model"),
+    )
+
+    def fresh():
+        return lm.TransformerLM.create(
+            jax.random.key(2), vocab=31, max_seq=32, dim=32, depth=2,
+            num_heads=2,
+        )
+
+    toks = jnp.asarray(
+        np.random.default_rng(3).integers(0, 31, size=(8, 33), dtype=np.int32)
+    )
+    optimizer = optax.adamw(1e-3)
+
+    model = fresh()
+    m_ref, _, loss_ref = lm.make_train_step(optimizer)(
+        model, optimizer.init(model), toks
+    )
+
+    model = lm.shard_params(fresh(), mesh)  # tp over "model"
+    assert model.blocks[0].wq.sharding.spec == P(None, "model")
+    step = lm.make_pp_train_step(
+        optimizer, mesh, n_micro=2, axis="pipe", data_axis="data"
+    )
+    toks_sh = jax.device_put(toks, NamedSharding(mesh, P("data", None)))
+    m_pp, _, loss_pp = step(model, optimizer.init(model), toks_sh)
+
+    np.testing.assert_allclose(float(loss_pp), float(loss_ref), atol=1e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(m_pp), jax.tree_util.tree_leaves(m_ref)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+
+
 def test_cosine_schedule_and_grad_clip(tmp_path):
     """Warmup-cosine + clipping trains (and the optimizer factory rejects
     bad configs loudly)."""

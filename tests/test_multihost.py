@@ -173,6 +173,50 @@ def test_two_process_lm_training_matches_single_process(
     )
 
 
+TP_WORKER = Path(__file__).with_name("multihost_tp_worker.py")
+
+
+def test_four_process_tp_and_pp_across_processes(tmp_path, free_tcp_port):
+    """Model and pipeline axes spanning REAL process boundaries (VERDICT
+    r3 #6): a (data=2, model=4) mesh over 4 processes x 2 devices puts
+    each tp weight shard group and each GPipe stage chain across gloo,
+    and each data row's batch shard is contributed by two processes.
+    dp x tp training and the dp x pp microbatch forward must equal
+    single-process results."""
+    out = tmp_path / "tp.npz"
+    logs = _run_workers(TP_WORKER, out, free_tcp_port, nprocs=4)
+    assert out.exists(), "process 0 wrote no tp state\n" + "\n".join(logs)
+
+    import jax.numpy as jnp
+
+    from _lm_worker_common import SEQ, build_tp, step_batch
+
+    # single-process training reference on the same batches
+    model, optimizer, step, corpus = build_tp()
+    opt_state = optimizer.init(model)
+    losses = []
+    for i in range(3):
+        model, opt_state, loss = step(
+            model, opt_state, jnp.asarray(step_batch(corpus, i))
+        )
+        losses.append(float(loss))
+
+    got = np.load(out)
+    np.testing.assert_allclose(got["losses"], losses, atol=1e-5)
+    np.testing.assert_allclose(
+        got["wq"], np.asarray(model.blocks[0].wq), atol=5e-5
+    )
+    np.testing.assert_allclose(
+        got["embed"], np.asarray(model.embed), atol=5e-5
+    )
+
+    # pipeline-parallel forward reference: the plain block chain
+    model2, _, _, _ = build_tp()
+    toks_pp = step_batch(corpus, 99)[:, :SEQ].astype(np.int32)
+    want = np.asarray(model2(jnp.asarray(toks_pp)))
+    np.testing.assert_allclose(got["pp"], want, atol=2e-4)
+
+
 CKPT_WORKER = Path(__file__).with_name("multihost_ckpt_worker.py")
 
 
