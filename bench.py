@@ -398,11 +398,15 @@ LM_SEQ, LM_BATCH, LM_VOCAB = 2048, 8, 32_768
 
 def _lm_train_step_rate(
     *, seq, dim, depth, heads, batch, pos_encoding="learned",
-    use_mesh=True, iters=3,
+    use_mesh=True, iters=3, remat=False,
 ) -> dict:
     """Shared scaffold for the LM train-step benches: build a bf16-policy
-    remat model, one donated train step, dp-shard the batch when a mesh
-    helps, and time steady-state steps."""
+    model, one donated train step, dp-shard the batch when a mesh helps,
+    and time steady-state steps. ``remat=False`` is the honest default at
+    these shapes: activations + logits fit HBM with room to spare, and
+    full remat would silently add ~1/3 recompute FLOPs the analytic
+    6·P·tokens model doesn't count (ROOFLINE.md §6). Pass remat="dots"
+    or "full" for memory-bound shapes."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -423,7 +427,12 @@ def _lm_train_step_rate(
         compute_dtype="bfloat16",
         pos_encoding=pos_encoding,
     )
-    model = dataclasses.replace(model, remat=True)
+    if remat:
+        # accept legacy remat=True as full remat, not a policy name
+        policy = "full" if remat is True else remat
+        model = dataclasses.replace(
+            model, remat=True, remat_policy=policy
+        )
     model = lm.shard_params(model, mesh)
     optimizer = optax.adamw(3e-4, weight_decay=0.01)
     opt_state = optimizer.init(model)

@@ -145,6 +145,12 @@ class TransformerLM:
     # boundaries only — the jax.checkpoint successor of the reference's
     # nothing (it never trained deep models)
     remat: bool = static_field(default=False)
+    # "full" recomputes everything inside the block (max memory saving,
+    # ~1/3 extra forward FLOPs in the backward); "dots" saves the matmul
+    # outputs and recomputes only the cheap elementwise/LN work — the
+    # memory/MFU middle ground (ROOFLINE.md §6): the MXU never re-runs,
+    # so measured step FLOPs stay at the analytic 6·P·tokens
+    remat_policy: str = static_field(default="full")
     # mixed precision: params/optimizer state stay float32; activations
     # and the matmul operands run in this dtype ("bfloat16" halves HBM
     # traffic and feeds the MXU its native input width). LayerNorm stats
@@ -254,7 +260,7 @@ class TransformerLM:
             return out, moe_aux
 
         if self.remat:
-            block_fn = jax.checkpoint(block_fn)
+            block_fn = remat_wrap(block_fn, self.remat_policy)
         aux = jnp.float32(0)
         for i, blk in enumerate(self.blocks):
             x, moe_aux = block_fn(x, blk, self._moe(i))
@@ -426,6 +432,19 @@ def shard_params(model: TransformerLM, mesh) -> TransformerLM:
         blocks=blocks,
         moe_layers=moes,
     )
+
+
+def remat_wrap(fn, policy: str):
+    """``jax.checkpoint`` under the model's remat policy (shared by the
+    layer loop and the pipeline-parallel stage chain)."""
+    if policy == "full":
+        return jax.checkpoint(fn)
+    if policy == "dots":
+        return jax.checkpoint(
+            fn,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        )
+    raise ValueError(f"remat_policy={policy!r}; expected full|dots")
 
 
 def token_cross_entropy(logits, targets) -> jnp.ndarray:

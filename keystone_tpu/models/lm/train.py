@@ -92,7 +92,9 @@ def pp_forward(model: TransformerLM, tokens, mesh, *, n_micro: int,
         return act
 
     if model.remat:
-        stage_fn = jax.checkpoint(stage_fn)
+        from keystone_tpu.models.lm.model import remat_wrap
+
+        stage_fn = remat_wrap(stage_fn, model.remat_policy)
     from keystone_tpu.parallel.pipeline_parallel import gpipe
 
     out = gpipe(stage_fn, stacked, x, mesh, axis=axis, data_axis=data_axis)

@@ -146,22 +146,31 @@ def test_generate_sampled_and_bounds():
 
 def test_remat_gradients_match():
     """jax.checkpoint per block changes memory, not math: grads with
-    remat on/off agree (bench runs remat=True + bf16, so cover both)."""
+    remat off / full remat / dots-saveable policy all agree (the dots
+    policy keeps matmul outputs so the MXU never re-runs — the bench's
+    memory-bound option)."""
     base = _tiny()
     toks = jnp.asarray(np.random.default_rng(11).integers(0, 31, size=(4, 32)))
     for cdt in ("float32", "bfloat16"):
         m = dataclasses.replace(base, compute_dtype=cdt)
         g_plain = jax.grad(lm.next_token_loss)(m, toks)
-        g_remat = jax.grad(lm.next_token_loss)(
-            dataclasses.replace(m, remat=True), toks
-        )
-        for a, b in zip(
-            jax.tree_util.tree_leaves(g_plain),
-            jax.tree_util.tree_leaves(g_remat),
-        ):
-            np.testing.assert_allclose(
-                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        for policy in ("full", "dots"):
+            g_remat = jax.grad(lm.next_token_loss)(
+                dataclasses.replace(m, remat=True, remat_policy=policy),
+                toks,
             )
+            for a, b in zip(
+                jax.tree_util.tree_leaves(g_plain),
+                jax.tree_util.tree_leaves(g_remat),
+            ):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+                )
+    with pytest.raises(ValueError):
+        lm.next_token_loss(
+            dataclasses.replace(base, remat=True, remat_policy="nope"),
+            toks,
+        )
 
 
 def test_cli_main_tiny():
