@@ -1,0 +1,55 @@
+"""Fused int8-dequant Pallas matmul vs the XLA mm() path.
+
+Runs in Pallas interpret mode on the CPU test mesh (the compiled path is
+exercised by the on-chip bench A/B — ROOFLINE.md §6 decode note)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu.ops.int8_matmul import mm_fused
+from keystone_tpu.ops.quantization import mm, quantize_int8
+
+
+@pytest.mark.parametrize(
+    "m,k,n",
+    [
+        (8, 256, 384),     # decode-ish: tiny M, K/N off the block grid
+        (1, 512, 512),     # matvec, exactly one block
+        (16, 700, 130),    # ragged K and N padding
+    ],
+)
+def test_mm_fused_matches_mm(rng, m, k, n):
+    w = rng.normal(size=(k, n)).astype(np.float32)
+    qt = quantize_int8(jnp.asarray(w))
+    y = jnp.asarray(rng.normal(size=(m, k)).astype(np.float32))
+    want = np.asarray(mm(y.astype(jnp.bfloat16), qt, jnp.bfloat16), np.float32)
+    got = np.asarray(
+        mm_fused(y, qt, block_n=256, block_k=256, interpret=True),
+        np.float32,
+    )
+    # both paths: bf16 operands, f32 accumulate, f32 scale — only the
+    # padded-tile zeros and op order differ
+    np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
+
+
+def test_mm_fused_batched_leading_dims(rng):
+    qt = quantize_int8(jnp.asarray(rng.normal(size=(128, 96)).astype(np.float32)))
+    y = jnp.asarray(rng.normal(size=(2, 3, 128)).astype(np.float32))
+    got = mm_fused(y, qt, block_n=128, block_k=128, interpret=True)
+    assert got.shape == (2, 3, 96)
+    flat = mm_fused(y.reshape(6, 128), qt, block_n=128, block_k=128,
+                    interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got).reshape(6, 96), np.asarray(flat), atol=1e-5
+    )
+
+
+def test_mm_fused_rejects_bad_scales(rng):
+    qt = quantize_int8(
+        jnp.asarray(rng.normal(size=(64, 32)).astype(np.float32)),
+        channel_axis=0,  # (64, 1) row scales — not per-output-channel
+    )
+    y = jnp.asarray(rng.normal(size=(4, 64)).astype(np.float32))
+    with pytest.raises(ValueError, match="per-output-channel"):
+        mm_fused(y, qt, interpret=True)

@@ -290,6 +290,53 @@ def main() -> None:
             round(int(ai.shape[0]) / sec, 1)
         )
 
+    # ---- int8 decode matmul A/B (VERDICT r3 #4) ----
+    # decode is HBM-bound: the metric is weight-stream GB/s, not FLOPs.
+    # Three contenders at the decode shapes (tiny M, the LM's K, the MLP
+    # and tied-logits N): bf16 weights (baseline bytes), int8 via XLA
+    # convert-into-dot (ops/quantization.mm — the bet), int8 via the
+    # fused Pallas kernel (ops/int8_matmul.mm_fused — the hedge). If
+    # xla_int8 ≈ bf16 time, XLA did NOT fuse and the kernel is the path.
+    if dev.platform != "cpu":
+        from keystone_tpu.ops.int8_matmul import mm_fused
+        from keystone_tpu.ops.quantization import mm as qmm, quantize_int8
+
+        m_dec, k_dec = 8, 1024
+        for n_dec in (4096, 32_768):
+            wd = jnp.asarray(
+                rng.normal(size=(k_dec, n_dec)).astype(np.float32)
+            )
+            qt = quantize_int8(wd)
+            yd = jnp.asarray(
+                rng.normal(size=(m_dec, k_dec)).astype(np.float32)
+            ).astype(jnp.bfloat16)
+            wb = wd.astype(jnp.bfloat16)
+            variants = {
+                "bf16": (lambda a, b: a @ b, (yd, wb), 2),
+                "xla_int8": (
+                    lambda a, q: qmm(a, q, jnp.bfloat16),
+                    (yd, qt),
+                    1,
+                ),
+                "pallas_int8": (
+                    lambda a, q: mm_fused(a, q),
+                    (yd, qt),
+                    1,
+                ),
+            }
+            for name, (fn, args, bytes_per_w) in variants.items():
+                # _inprog, NOT per-dispatch: these matmuls are tens of
+                # µs — a per-dispatch timing would measure only the
+                # launch floor and the A/B verdict would be noise
+                sec = _inprog(fn, args, reps=64)
+                stream = k_dec * n_dec * bytes_per_w
+                out["phases"][f"decode_mm_{name}_n{n_dec}"] = {
+                    "ms": round(sec * 1e3, 4),
+                    "weight_stream_gb_per_s": round(
+                        stream / sec / 1e9, 1
+                    ),
+                }
+
     path = os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "MFU_SWEEP.json",
