@@ -534,13 +534,22 @@ def bench_lm_decode() -> dict:
     step_s, sec_prefill = decode_rate(model)
     # weight-only int8: decode re-reads all params every step (HBM-bound);
     # the measured side-by-side rate is the honest claim (whether the
-    # weight stream halves rests on XLA fusing the convert into the dot)
-    step_q, _ = decode_rate(lm.quantize_for_decode(model))
+    # weight stream halves rests on XLA fusing the convert into the dot).
+    # The pallas variant streams the BLOCK weights as int8 by
+    # construction (ops/int8_matmul); the tied-embedding logits matmul
+    # (~1/4 of the per-step weight bytes, (V,1) row scales) takes the
+    # XLA path in both legs — the e2e leg of mfu_sweep's decode_mm_* A/B
+    qmodel = lm.quantize_for_decode(model)
+    step_q, _ = decode_rate(qmodel)
+    step_qp, _ = decode_rate(
+        dataclasses.replace(qmodel, int8_kernel="pallas")
+    )
     return {
         "decode_tokens_per_s": LM_BATCH / step_s,
         "ms_per_step": step_s * 1e3,
         "prefill_ms": sec_prefill * 1e3,
         "decode_int8_tokens_per_s": LM_BATCH / step_q,
+        "decode_int8_pallas_tokens_per_s": LM_BATCH / step_qp,
     }
 
 
@@ -822,6 +831,9 @@ def main() -> None:
         )
         result["lm_decode_int8_tokens_per_s"] = round(
             lm_dec["decode_int8_tokens_per_s"], 1
+        )
+        result["lm_decode_int8_pallas_tokens_per_s"] = round(
+            lm_dec["decode_int8_pallas_tokens_per_s"], 1
         )
     if lm_long is not None:
         result["lm_longctx16k_tokens_per_s"] = round(

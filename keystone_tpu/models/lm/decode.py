@@ -28,8 +28,9 @@ from keystone_tpu.models.lm.model import (
     _embed,
     _gather_embed,
     _tied_logits,
+    model_mm,
 )
-from keystone_tpu.ops.quantization import mm, quantize_int8
+from keystone_tpu.ops.quantization import quantize_int8
 
 
 @treenode
@@ -84,6 +85,7 @@ def prefill(model: TransformerLM, tokens, s_max: int,
             x, blk, cdt,
             lambda y, b: model._attention(y, b, return_kv=True),
             moe=model._moe(i),
+            mm_fn=model_mm(model),
         )
         ks.append(k)
         vs.append(v)
@@ -174,7 +176,7 @@ def decode_step(model: TransformerLM, token, cache: KVCache):
                 layer_v.astype(cdt),
                 preferred_element_type=jnp.float32,
             )
-            proj = mm(
+            proj = mm_fn(
                 out.reshape(n, h, 1, hd).transpose(0, 2, 1, 3).reshape(
                     n, 1, d
                 ).astype(cdt),
@@ -185,8 +187,11 @@ def decode_step(model: TransformerLM, token, cache: KVCache):
 
         return attn
 
+    mm_fn = model_mm(model)
     for i, blk in enumerate(model.blocks):
-        x, _, _ = _block_apply(x, blk, cdt, cached_attn(i), moe=model._moe(i))
+        x, _, _ = _block_apply(
+            x, blk, cdt, cached_attn(i), moe=model._moe(i), mm_fn=mm_fn
+        )
     logits = _tied_logits(x, model.embed, cdt)[:, 0]
     # past-capacity poison: at pos >= S_max the cache write would clamp
     # onto S_max-1 and return plausible-but-wrong logits; pos is traced,

@@ -57,9 +57,39 @@ def _ln(x, cdt):
     return _layer_norm(x.astype(jnp.float32)).astype(cdt)
 
 
-def _split_heads(y, w, h):
+def model_mm(model):
+    """The matmul the model's int8 weights go through: plain ``mm`` or,
+    under ``int8_kernel="pallas"``, the fused dequant kernel for
+    per-output-channel-scaled QTensors (float weights always take
+    ``mm``)."""
+    if model.int8_kernel == "xla":
+        return mm
+    if model.int8_kernel != "pallas":
+        raise ValueError(
+            f"int8_kernel={model.int8_kernel!r}; expected xla|pallas"
+        )
+
+    def pallas_mm(y, w, dt):
+        # decode-sized M only: mm_fused carries the whole M extent in
+        # one VMEM tile, which is the right shape for a handful of
+        # decode rows and a VMEM blow-up for prefill/forward (B·S rows)
+        m_rows = int(np.prod(y.shape[:-1]))
+        if (
+            isinstance(w, QTensor)
+            and w.scale.shape == (1, w.q.shape[1])
+            and m_rows <= 64
+        ):
+            from keystone_tpu.ops.int8_matmul import mm_fused
+
+            return mm_fused(y.astype(dt), w).astype(dt)
+        return mm(y, w, dt)
+
+    return pallas_mm
+
+
+def _split_heads(y, w, h, mm_fn=mm):
     n, s, _ = y.shape
-    out = mm(y, w, y.dtype)  # (n, s, h·hd) — rectangular for GQA K/V
+    out = mm_fn(y, w, y.dtype)  # (n, s, h·hd) — rectangular for GQA K/V
     return out.reshape(n, s, h, out.shape[-1] // h).transpose(0, 2, 1, 3)
 
 
@@ -79,7 +109,7 @@ def _rope(x, positions, base: float = 10_000.0):
     ).astype(x.dtype)
 
 
-def _block_apply(x, blk: LMBlock, cdt, attn, moe=None):
+def _block_apply(x, blk: LMBlock, cdt, attn, moe=None, mm_fn=mm):
     """Pre-LN residual block shared by training forward, prefill, and
     decode: ``attn(y, blk) -> (attention output (N,S,d), aux)``. When
     ``moe`` is given it replaces the dense FFN; returns
@@ -90,8 +120,8 @@ def _block_apply(x, blk: LMBlock, cdt, attn, moe=None):
     if moe is not None:
         f, moe_aux = moe(y)
         return x + f, aux, moe_aux
-    hdn = mm(y, blk.w1, cdt)
-    return x + mm(jax.nn.gelu(hdn), blk.w2, cdt), aux, jnp.float32(0)
+    hdn = mm_fn(y, blk.w1, cdt)
+    return x + mm_fn(jax.nn.gelu(hdn), blk.w2, cdt), aux, jnp.float32(0)
 
 
 def _gather_embed(embed, tokens):
@@ -168,6 +198,11 @@ class TransformerLM:
     # plain MHA; 1 = MQA). The decode cache shrinks by num_heads/kv_heads
     # — composing with kv_dtype="int8" for the full serving story
     num_kv_heads: int = static_field(default=0)
+    # how int8 QTensor weights multiply: "xla" trusts the convert-into-
+    # dot fusion (ops/quantization.mm); "pallas" streams the codes as
+    # int8 via the fused kernel (ops/int8_matmul.mm_fused) — the A/B the
+    # bench measures e2e (ROOFLINE.md §6 decode note)
+    int8_kernel: str = static_field(default="xla")
 
     @property
     def kv_heads(self) -> int:
@@ -177,9 +212,10 @@ class TransformerLM:
         """(q with H heads, k/v with KV heads, rope applied).
         ``positions`` defaults to 0..S-1 (full-sequence forward); decode
         passes the single global position of its new token."""
-        q = _split_heads(x, blk.wq, self.num_heads)
-        k = _split_heads(x, blk.wk, self.kv_heads)
-        v = _split_heads(x, blk.wv, self.kv_heads)
+        mm_fn = model_mm(self)
+        q = _split_heads(x, blk.wq, self.num_heads, mm_fn)
+        k = _split_heads(x, blk.wk, self.kv_heads, mm_fn)
+        v = _split_heads(x, blk.wv, self.kv_heads, mm_fn)
         if self.pos_encoding == "rope":
             if positions is None:
                 positions = jnp.arange(x.shape[1])
@@ -230,7 +266,7 @@ class TransformerLM:
                 out = flash_attention_trainable(q, k, v, True)
             else:
                 out = dense_attention(q, k, v, causal=True)
-        proj = mm(
+        proj = model_mm(self)(
             out.transpose(0, 2, 1, 3).reshape(n, s, d).astype(x.dtype),
             blk.wo,
             x.dtype,
@@ -256,6 +292,7 @@ class TransformerLM:
                 x, blk, cdt,
                 lambda y, b: (self._attention(y, b), None),
                 moe=moe,
+                mm_fn=model_mm(self),
             )
             return out, moe_aux
 

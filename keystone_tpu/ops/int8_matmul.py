@@ -36,8 +36,9 @@ from keystone_tpu.ops.quantization import QTensor
 
 def _kernel(y_ref, q_ref, s_ref, o_ref, acc_ref, *, n_k: int):
     """One (M, N_blk) output tile; grid = (N tiles, K tiles) with K the
-    minor (sequential) dimension. y (M, K_blk) bf16; q (K_blk, N_blk)
-    int8; s (1, N_blk) f32 scale applied once at the last K step."""
+    minor (sequential) dimension. y (M, K_blk) in the caller's compute
+    dtype; q (K_blk, N_blk) int8; s (1, N_blk) f32 scale applied once at
+    the last K step."""
     k = pl.program_id(1)
 
     @pl.when(k == 0)
@@ -45,10 +46,12 @@ def _kernel(y_ref, q_ref, s_ref, o_ref, acc_ref, *, n_k: int):
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
     # the widening happens HERE, after the int8 bytes landed in VMEM —
-    # the HBM stream stays 1 byte/weight
+    # the HBM stream stays 1 byte/weight. Widen to y's dtype so the
+    # kernel matches quantization.mm's compute semantics (bf16 policy →
+    # bf16 MXU passes; f32 → f32 emulation), f32 accumulate either way
     acc_ref[...] += jnp.dot(
         y_ref[...],
-        q_ref[...].astype(jnp.bfloat16),
+        q_ref[...].astype(y_ref.dtype),
         preferred_element_type=jnp.float32,
     )
 
@@ -92,9 +95,12 @@ def mm_fused(
     k_dim = y.shape[-1]
     if k_dim != w.q.shape[0]:
         raise ValueError(f"contraction mismatch: {y.shape} @ {w.q.shape}")
-    ym = y.reshape(-1, k_dim).astype(jnp.bfloat16)
+    ym = y.reshape(-1, k_dim)
     m = ym.shape[0]
-    # MXU-friendly tiles: M to the 16-sublane bf16 tile, K/N to blocks
+    # MXU-friendly tiles: M to the 16-sublane tile, K/N to blocks. The
+    # whole M extent rides in one tile (plus an (M, block_n) scratch) —
+    # this kernel is for decode's tiny-M regime; callers keep large-M
+    # shapes on the XLA path (see models/lm/model.model_mm)
     ym = _pad_dim(_pad_dim(ym, 0, 16), 1, block_k)
     q = _pad_dim(_pad_dim(w.q, 0, block_k), 1, block_n)
     s = _pad_dim(w.scale.astype(jnp.float32), 1, block_n)
