@@ -398,7 +398,7 @@ LM_SEQ, LM_BATCH, LM_VOCAB = 2048, 8, 32_768
 
 def _lm_train_step_rate(
     *, seq, dim, depth, heads, batch, pos_encoding="learned",
-    use_mesh=True, iters=3, remat=False,
+    use_mesh=True, iters=3, remat=False, logit_chunk=0,
 ) -> dict:
     """Shared scaffold for the LM train-step benches: build a bf16-policy
     model, one donated train step, dp-shard the batch when a mesh helps,
@@ -436,7 +436,7 @@ def _lm_train_step_rate(
     model = lm.shard_params(model, mesh)
     optimizer = optax.adamw(3e-4, weight_decay=0.01)
     opt_state = optimizer.init(model)
-    step = lm.make_train_step(optimizer)
+    step = lm.make_train_step(optimizer, logit_chunk=logit_chunk)
     toks = jnp.asarray(
         np.random.default_rng(0).integers(
             0, LM_VOCAB, size=(batch, seq + 1), dtype=np.int32
@@ -488,6 +488,9 @@ def bench_lm_longctx() -> dict:
     res = _lm_train_step_rate(
         seq=LM_LONG_SEQ, dim=LM_LONG_DIM, depth=LM_LONG_DEPTH, heads=8,
         batch=1, pos_encoding="rope", use_mesh=False, iters=2,
+        # never materialize the (S, 32k-vocab) f32 logits (2.1 GB + its
+        # grad at S=16k): the CE runs in 4k-position chunks
+        logit_chunk=4096,
     )
     res.pop("params", None)
     return res

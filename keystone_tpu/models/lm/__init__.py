@@ -21,6 +21,7 @@ from keystone_tpu.models.lm.decode import (
 from keystone_tpu.models.lm.model import (
     LMBlock,
     TransformerLM,
+    chunked_token_cross_entropy,
     next_token_loss,
     shard_params,
     token_cross_entropy,
@@ -40,6 +41,7 @@ __all__ = [
     "KVCache",
     "LMBlock",
     "TransformerLM",
+    "chunked_token_cross_entropy",
     "decode_step",
     "generate",
     "make_optimizer",

@@ -282,8 +282,10 @@ class TransformerLM:
         """(B, S) int tokens → (B, S, V) float32 logits."""
         return self.forward_with_aux(tokens)[0]
 
-    def forward_with_aux(self, tokens):
-        """(logits (B, S, V) f32, total MoE load-balance aux loss)."""
+    def backbone(self, tokens):
+        """(final hidden states (B, S, d) pre-logits, MoE aux loss) —
+        the forward minus the tied-logits projection, so losses can
+        choose how (or whether) to materialize logits."""
         cdt = jnp.dtype(self.compute_dtype)
         x = _embed(self, tokens, cdt)
 
@@ -302,6 +304,12 @@ class TransformerLM:
         for i, blk in enumerate(self.blocks):
             x, moe_aux = block_fn(x, blk, self._moe(i))
             aux = aux + moe_aux
+        return x, aux
+
+    def forward_with_aux(self, tokens):
+        """(logits (B, S, V) f32, total MoE load-balance aux loss)."""
+        x, aux = self.backbone(tokens)
+        cdt = jnp.dtype(self.compute_dtype)
         return _tied_logits(x, self.embed, cdt), aux
 
     @staticmethod
@@ -493,10 +501,51 @@ def token_cross_entropy(logits, targets) -> jnp.ndarray:
     return jnp.mean(logz - gold)
 
 
-def next_token_loss(model: TransformerLM, tokens) -> jnp.ndarray:
+def chunked_token_cross_entropy(x, embed, targets, cdt, chunk: int):
+    """Mean next-token CE from final hidden states without ever holding
+    the (B, S, V) f32 logits: positions are processed in S-chunks — each
+    chunk's logits are built, reduced to ``logsumexp − gold``, and
+    dropped (``jax.checkpoint`` recomputes them in the backward). At
+    long context the full logits tensor is the step's single largest
+    HBM object (S=16k × V=32k f32 = 2.1 GB, twice more with its grad);
+    chunking turns that into ``chunk`` × V working set."""
+    b, s, d = x.shape
+    if s % chunk:
+        raise ValueError(f"sequence {s} not divisible by logit_chunk={chunk}")
+    n_c = s // chunk
+    xc = x.reshape(b, n_c, chunk, d).transpose(1, 0, 2, 3)
+    tc = targets.reshape(b, n_c, chunk).transpose(1, 0, 2)
+
+    @jax.checkpoint
+    def chunk_sum(xx, tt):
+        logits = _tied_logits(xx, embed, cdt)  # (B, chunk, V) f32
+        # token_cross_entropy stays the single source of the CE form;
+        # mean × count turns it back into this chunk's sum exactly
+        return token_cross_entropy(logits, tt) * tt.size
+
+    total, _ = jax.lax.scan(
+        lambda c, args: (c + chunk_sum(*args), None),
+        jnp.float32(0),
+        (xc, tc),
+    )
+    return total / (b * s)
+
+
+def next_token_loss(
+    model: TransformerLM, tokens, logit_chunk: int = 0
+) -> jnp.ndarray:
     """Mean cross-entropy of predicting ``tokens[:, 1:]`` from the prefix
     (the model runs on the first S tokens of an S+1 window), plus the
-    weighted MoE load-balance auxiliary when the model routes."""
+    weighted MoE load-balance auxiliary when the model routes.
+    ``logit_chunk > 0`` computes the CE in S-chunks so the full (B, S, V)
+    f32 logits never materialize (see chunked_token_cross_entropy)."""
+    if logit_chunk:
+        cdt = jnp.dtype(model.compute_dtype)
+        x, aux = model.backbone(tokens[:, :-1])
+        ce = chunked_token_cross_entropy(
+            x, model.embed, tokens[:, 1:], cdt, logit_chunk
+        )
+        return ce + model.moe_aux_weight * aux
     logits, aux = model.forward_with_aux(tokens[:, :-1])
     ce = token_cross_entropy(logits, tokens[:, 1:])
     return ce + model.moe_aux_weight * aux

@@ -140,12 +140,17 @@ def make_pp_train_step(optimizer, mesh, *, n_micro: int,
     return step
 
 
-def make_train_step(optimizer):
-    """One buffer-donated jitted program: grads + AdamW update + loss."""
+def make_train_step(optimizer, *, logit_chunk: int = 0):
+    """One buffer-donated jitted program: grads + AdamW update + loss.
+    ``logit_chunk`` chunks the CE so the (B, S, V) f32 logits never
+    materialize (the long-context memory/bandwidth lever — see
+    :func:`keystone_tpu.models.lm.model.chunked_token_cross_entropy`)."""
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(model, opt_state, tokens):
-        loss, grads = jax.value_and_grad(next_token_loss)(model, tokens)
+        loss, grads = jax.value_and_grad(
+            functools.partial(next_token_loss, logit_chunk=logit_chunk)
+        )(model, tokens)
         updates, opt_state = optimizer.update(
             grads, opt_state, params=model
         )
