@@ -510,8 +510,11 @@ def chunked_token_cross_entropy(x, embed, targets, cdt, chunk: int):
     HBM object (S=16k × V=32k f32 = 2.1 GB, twice more with its grad);
     chunking turns that into ``chunk`` × V working set."""
     b, s, d = x.shape
-    if s % chunk:
-        raise ValueError(f"sequence {s} not divisible by logit_chunk={chunk}")
+    if chunk <= 0 or s % chunk:
+        raise ValueError(
+            f"logit_chunk={chunk} must be a positive divisor of the "
+            f"sequence length {s}"
+        )
     n_c = s // chunk
     xc = x.reshape(b, n_c, chunk, d).transpose(1, 0, 2, 3)
     tc = targets.reshape(b, n_c, chunk).transpose(1, 0, 2)

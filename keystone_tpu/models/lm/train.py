@@ -217,6 +217,7 @@ def train(
     checkpoint_every: int = 0,
     schedule: str = "constant",
     grad_clip: float = 0.0,
+    logit_chunk: int = 0,
 ):
     """Train on random windows of ``corpus`` (1-D int array). Returns
     (model, losses). Batches are dp-sharded over the mesh ``data`` axis
@@ -233,7 +234,10 @@ def train(
     ``schedule="cosine"`` derives its decay horizon from THIS invocation's
     ``steps`` — resuming with a longer schedule is allowed (steps are not
     run identity) but stretches the cosine rather than replaying the
-    original horizon.
+    original horizon. ``logit_chunk`` chunks the CE — equivalent to the
+    dense loss up to FP reduction order, which is exactly why it IS part
+    of the run identity (a resume must not silently change the low bits
+    of the trajectory).
     """
     import hashlib
 
@@ -257,7 +261,7 @@ def train(
         lr, steps=steps, schedule=schedule, grad_clip=grad_clip
     )
     opt_state = optimizer.init(model)
-    step = make_train_step(optimizer)
+    step = make_train_step(optimizer, logit_chunk=logit_chunk)
     losses = []
     sharding = None
     if (
@@ -294,6 +298,7 @@ def train(
                 "seed": seed,
                 "schedule": schedule,
                 "grad_clip": grad_clip,
+                "logit_chunk": logit_chunk,
                 "num_heads": model.num_heads,
                 # normalized (kv_heads, never the 0 alias) so MHA spelled
                 # either way compares equal
@@ -327,6 +332,8 @@ def train(
                 "pos_encoding": "learned",
                 "schedule": "constant",
                 "grad_clip": 0.0,
+                # pre-chunked-CE checkpoints were all dense
+                "logit_chunk": 0,
                 # pre-GQA checkpoints were all MHA
                 "num_kv_heads": model.num_heads,
             },

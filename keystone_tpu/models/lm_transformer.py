@@ -102,6 +102,12 @@ class LMConfig:
         default=0,
         help="steps between checkpoints (0 = steps//10, ~10 per run)",
     )
+    logit_chunk: int = arg(
+        default=0,
+        help="compute the CE in this many-position chunks so the "
+        "(B, S, V) f32 logits never materialize (0 = dense; must divide "
+        "seq; the long-context memory/bandwidth lever)",
+    )
 
 
 def run(conf: LMConfig, mesh=None) -> dict:
@@ -154,6 +160,7 @@ def run(conf: LMConfig, mesh=None) -> dict:
         checkpoint_every=conf.checkpoint_every,
         schedule=conf.schedule,
         grad_clip=conf.grad_clip,
+        logit_chunk=conf.logit_chunk,
     )
     dt = time.time() - t0
     steps_ran = len(losses)

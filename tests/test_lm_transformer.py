@@ -492,8 +492,10 @@ def test_chunked_loss_matches_dense():
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
             )
-    with pytest.raises(ValueError, match="not divisible"):
+    with pytest.raises(ValueError, match="positive divisor"):
         lm.next_token_loss(m, toks, logit_chunk=7)
+    with pytest.raises(ValueError, match="positive divisor"):
+        lm.next_token_loss(m, toks, logit_chunk=-8)
     # and through the jitted train step factory
     import optax
 
