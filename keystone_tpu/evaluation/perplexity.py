@@ -10,6 +10,7 @@ bits-per-byte, the enwik8 headline metric).
 
 from __future__ import annotations
 
+import functools
 import math
 
 import jax
@@ -17,13 +18,24 @@ import jax.numpy as jnp
 import numpy as np
 
 
-@jax.jit
-def _ce(model, toks):
+@functools.partial(jax.jit, static_argnames=("logit_chunk",))
+def _ce(model, toks, logit_chunk: int = 0):
     """Pure cross-entropy (module-level so the jit cache persists across
     evaluate_perplexity calls): next_token_loss adds the MoE load-balance
-    aux, which is a training regularizer, not model quality."""
-    from keystone_tpu.models.lm_transformer import token_cross_entropy
+    aux, which is a training regularizer, not model quality.
+    ``logit_chunk`` mirrors the training option — at long eval sequences
+    the (B, S, V) f32 logits are the same HBM object to avoid."""
+    from keystone_tpu.models.lm_transformer import (
+        chunked_token_cross_entropy,
+        token_cross_entropy,
+    )
 
+    if logit_chunk:
+        x, _ = model.backbone(toks[:, :-1])
+        return chunked_token_cross_entropy(
+            x, model.embed, toks[:, 1:],
+            jnp.dtype(model.compute_dtype), logit_chunk,
+        )
     logits, _ = model.forward_with_aux(toks[:, :-1])
     return token_cross_entropy(logits, toks[:, 1:])
 
@@ -34,6 +46,7 @@ def evaluate_perplexity(
     *,
     seq: int,
     batch: int = 8,
+    logit_chunk: int = 0,
 ) -> dict:
     """Mean next-token cross-entropy of ``model`` over ``tokens``.
 
@@ -41,7 +54,8 @@ def evaluate_perplexity(
     except window-leading tokens which are conditioned on nothing from
     the previous window — the standard simple protocol); a ragged tail
     shorter than S+1 is dropped. Returns {loss, perplexity,
-    bits_per_token, tokens_scored}.
+    bits_per_token, tokens_scored}. ``logit_chunk`` evaluates the CE in
+    S-chunks (see ``models/lm``) — identical numbers up to FP order.
     """
     window = seq + 1
     n_win = len(tokens) // window
@@ -54,14 +68,13 @@ def evaluate_perplexity(
         n_win, window
     )
 
-    loss_fn = _ce
     total, count = 0.0, 0
     for i in range(0, n_win, batch):
         chunk = jnp.asarray(wins[i : i + batch])
         # next_token_loss averages over the chunk's predicted tokens;
         # re-weight by token count so uneven tail chunks don't skew
         n_tok = chunk.shape[0] * seq
-        total += float(loss_fn(model, chunk)) * n_tok
+        total += float(_ce(model, chunk, logit_chunk)) * n_tok
         count += n_tok
     loss = total / count
     return {

@@ -26,6 +26,7 @@ from keystone_tpu.models.lm import (  # noqa: F401  (re-exported surface)
     KVCache,
     LMBlock,
     TransformerLM,
+    chunked_token_cross_entropy,
     decode_step,
     generate,
     make_optimizer,
@@ -187,7 +188,8 @@ def run(conf: LMConfig, mesh=None) -> dict:
             )
 
             ev = evaluate_perplexity(
-                model, valid, seq=conf.seq, batch=conf.batch
+                model, valid, seq=conf.seq, batch=conf.batch,
+                logit_chunk=conf.logit_chunk,
             )
             res["valid_loss"] = ev["loss"]
             res["valid_bits_per_token"] = ev["bits_per_token"]

@@ -71,6 +71,9 @@ def test_lm_on_real_text_improves_heldout_bits(tmp_path):
     assert np.isclose(
         after["perplexity"], np.exp(after["loss"]), rtol=1e-6
     )
+    # chunked CE evaluation matches dense up to FP order
+    chunked = evaluate_perplexity(model, valid_toks, seq=64, logit_chunk=16)
+    assert np.isclose(chunked["loss"], after["loss"], rtol=1e-5)
 
 
 def test_cli_with_corpus(tmp_path):
