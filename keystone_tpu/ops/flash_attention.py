@@ -237,8 +237,8 @@ def flash_attention(
     v,
     *,
     causal: bool = False,
-    block_q: int = 512,
-    block_k: int = 512,
+    block_q: int | None = None,
+    block_k: int | None = None,
     q_offset=0,
     k_offset=0,
     mxu_dtype=None,
@@ -268,6 +268,10 @@ def flash_attention(
     """
     if interpret is None:
         interpret = not _on_tpu()
+    if block_q is None:
+        block_q = _env_int("KST_FLASH_BLOCK_Q", 512)
+    if block_k is None:
+        block_k = _env_int("KST_FLASH_BLOCK_K", 512)
     b, h, s_q, d = q.shape
     s_k = k.shape[2]
     scale = 1.0 / math.sqrt(d)
@@ -566,11 +570,23 @@ def flash_attention_step(
     )
 
 
+def _env_int(name: str, default: int) -> int:
+    """Tuning knob from the environment (the flash_sweep harness sets
+    these per subprocess to map the block-size space on chip; normal use
+    never sets them)."""
+    import os
+
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
 # bytes budget for the dense-recompute backward's transient (S_q, S_k)
 # tensors (~4 of them, f32, per (b, h)): above this the blockwise
 # O(S·block) backward takes over
 _DENSE_BWD_MAX_BYTES = 4 << 30
-_BWD_BLOCK = 512
+_BWD_BLOCK = _env_int("KST_FLASH_BWD_BLOCK", 512)
 
 
 def _dense_bwd_bytes(q, k) -> int:
@@ -595,7 +611,7 @@ def _bwd_mask(q_pos, k_pos, s_k_valid, causal: bool):
 # More chunks → closer to the ideal 0.5·S² triangle (n chunks execute
 # (n+1)/2n of the rectangle) at the cost of shorter scans; 8 is a good
 # regular-pipelining compromise (0.5625·S²)
-_BWD_CAUSAL_CHUNKS = 8
+_BWD_CAUSAL_CHUNKS = _env_int("KST_FLASH_BWD_CHUNKS", 8)
 
 
 def _grads_rect(qf, kp, vp, gf, delta, lse, q_off, s_k_valid, causal, block,

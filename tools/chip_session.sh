@@ -3,25 +3,29 @@
 # tunnel window still captures the round gate first:
 #   1. bench.py                  -> BENCH_TPU_LAST.json (driver-verifiable record)
 #   2. tools/mfu_sweep.py        -> MFU_SWEEP.json (roofline phase split)
-#   3. tools/tpu_validate.py     -> TPU_VALIDATION.json (Pallas keep/retire data)
-#   4. tools/imagenet_scale_run.py (reduced then full) -> IMAGENET_SCALE.json
+#   3. tools/flash_sweep.py      -> FLASH_SWEEP.json (long-context block tuning)
+#   4. tools/tpu_validate.py     -> TPU_VALIDATION.json (Pallas keep/retire data)
+#   5. tools/imagenet_scale_run.py (reduced then full) -> IMAGENET_SCALE.json
 # Run with no JAX_PLATFORMS pin (the default env reaches the chip).
 set -uo pipefail
 DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
 cd "$DIR"
 log() { echo "=== $(date -u +%FT%TZ) $*"; }
 
-log "1/4 bench.py"
+log "1/5 bench.py"
 timeout 2700 python bench.py || log "bench.py FAILED ($?)"
 
-log "2/4 mfu_sweep"
+log "2/5 mfu_sweep"
 timeout 1800 python tools/mfu_sweep.py || log "mfu_sweep FAILED ($?)"
 
-log "3/4 tpu_validate (incl. 32k long-context fwd + train probes)"
+log "3/5 flash block sweep (long-context MFU lever)"
+timeout 3600 python tools/flash_sweep.py || log "flash_sweep FAILED ($?)"
+
+log "4/5 tpu_validate (incl. 32k long-context fwd + train probes)"
 TPU_VALIDATE_LONG=1 timeout 3600 python tools/tpu_validate.py \
   || log "tpu_validate FAILED ($?)"
 
-log "4/4 imagenet scale (reduced 20k warmup, then full 100k)"
+log "5/5 imagenet scale (reduced 20k warmup, then full 100k)"
 timeout 3600 python tools/imagenet_scale_run.py \
   --num-images 20000 --out IMAGENET_SCALE_20K.json \
   || log "imagenet 20k FAILED ($?)"
