@@ -314,7 +314,8 @@ def weighted_imagenet_problem():
     solve — the single home of this workload's data generation and cost
     model, shared with tools/mfu_sweep.py. The FLOPs follow the same
     structure as bench_weighted (see weighted_linear.py); here the
-    2·C·d²·(L+1) Woodbury prep dominates (~2.2 PFLOPs at L_pad=64)."""
+    2·C·d²·(L+1) Woodbury prep dominates (~2.2 of the ~3.6 TFLOPs at
+    L_pad=64)."""
     import jax.numpy as jnp
 
     from keystone_tpu.ops.weighted_linear import (
@@ -346,8 +347,9 @@ def weighted_imagenet_problem():
 def bench_weighted_imagenet() -> dict:
     """Class-weighted BCD fit at the ImageNet solver shape (d=4096,
     C=1000): records the Woodbury path's FLOP rate at the shape it was
-    designed for. TPU-only (the ~2 PFLOP fit is minutes of host time on
-    the CPU fallback; the TIMIT workload covers the weighted solver
+    designed for. TPU-only (the ~3.6 TFLOP fit is a couple of minutes
+    of host BLAS on the CPU fallback — too slow for the fallback's
+    prompt-finish goal; the TIMIT workload covers the weighted solver
     there)."""
     import jax
 
