@@ -510,6 +510,33 @@ def test_chunked_loss_matches_dense():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+def test_chunked_loss_composes_with_moe_and_ring_sp(mesh8):
+    """logit_chunk must preserve the MoE aux term (it rides backbone(),
+    not the logits) and train through ring sequence parallelism."""
+    moe = lm.TransformerLM.create(
+        jax.random.key(4), vocab=31, max_seq=32, dim=32, depth=2,
+        num_heads=2, moe_every=2, num_experts=4,
+    )
+    toks = jnp.asarray(
+        np.random.default_rng(9).integers(0, 31, size=(4, 33), dtype=np.int32)
+    )
+    dense_l = lm.next_token_loss(moe, toks)
+    chunk_l = lm.next_token_loss(moe, toks, logit_chunk=16)
+    np.testing.assert_allclose(float(chunk_l), float(dense_l), rtol=1e-6)
+
+    ring = lm.TransformerLM.create(
+        jax.random.key(5), vocab=31, max_seq=64, dim=32, depth=2,
+        num_heads=2, seq_mode="ring", mesh=mesh8,
+    )
+    # seq 64 shards 8 ways; chunk 16 operates on the gathered states
+    toks64 = jnp.asarray(
+        np.random.default_rng(10).integers(0, 31, size=(2, 65), dtype=np.int32)
+    )
+    ring_dense = lm.next_token_loss(ring, toks64)
+    ring_chunk = lm.next_token_loss(ring, toks64, logit_chunk=16)
+    np.testing.assert_allclose(float(ring_chunk), float(ring_dense), rtol=1e-6)
+
+
 def test_pp_dp_tp_three_axis_composition(devices):
     """pp x dp x tp on a 3-axis mesh: stages manual over `pipe`,
     microbatch batch-dim manual over `data`, and the `model` axis left
