@@ -509,6 +509,60 @@ def validate_long_context(results):
     }
 
 
+def validate_long_decode(results):
+    """Long-context SERVING probe (round 4): 16k-token prefill into a
+    GQA int8 KV cache, then autoregressive decode — the full serving
+    stack (flash prefill, grouped decode that never materializes
+    repeated K/V, per-position int8 cache whose scales factor out of
+    both dots) measured as one jitted generate program. Opt-in via
+    TPU_VALIDATE_LONG=1."""
+    import dataclasses
+
+    from keystone_tpu.models import lm_transformer as lm
+
+    rng = np.random.default_rng(7)
+    s_prompt, new = 16_384, 64
+    model = lm.TransformerLM.create(
+        jax.random.key(0), vocab=32_768, max_seq=s_prompt + new, dim=512,
+        depth=4, num_heads=8, num_kv_heads=2, compute_dtype="bfloat16",
+        pos_encoding="rope",
+    )
+    # int8 WEIGHTS are the claim — quantize, then route through the
+    # fused Pallas kernel (float weights would make the flag a no-op)
+    model = dataclasses.replace(
+        lm.quantize_for_decode(model), int8_kernel="pallas"
+    )
+    prompt = jnp.asarray(
+        rng.integers(0, 32_768, size=(1, s_prompt), dtype=np.int32)
+    )
+
+    def gen(p):
+        return lm.generate(model, p, max_new=new, kv_dtype="int8")
+
+    t0 = time.perf_counter()
+    toks = gen(prompt)
+    jax.block_until_ready(toks)
+    first_run_s = time.perf_counter() - t0
+    t = _time(gen, prompt, iters=2)
+    # int8 codes streamed per decode step — K AND V buffers, shapes
+    # derived from the model so the record can't desync from create()
+    n_layers = len(model.blocks)
+    hd = 512 // model.num_heads
+    s_max = s_prompt + new
+    cache_mb = 2 * n_layers * 1 * model.kv_heads * s_max * hd / 1e6
+    results["serve_16k_gqa_int8kv"] = {
+        "prompt": s_prompt,
+        "new_tokens": new,
+        "kv_heads": f"{model.kv_heads} of {model.num_heads} (GQA)",
+        "cache_int8_mb": round(cache_mb, 1),
+        "compile_plus_first_run_s": round(first_run_s, 1),
+        "generate_ms": round(t * 1e3, 1),
+        "note": "one jitted program: flash prefill + lax.scan decode, "
+        "int8 KV cache (k+v codes above, + ~1/64 of that in f32 "
+        "scales) and int8 weights via the fused Pallas matmul",
+    }
+
+
 def main() -> int:
     import os
 
@@ -529,6 +583,7 @@ def main() -> int:
     validate_weighted_solver_scale(results)
     if os.environ.get("TPU_VALIDATE_LONG"):
         validate_long_context(results)
+        validate_long_decode(results)
     out = REPO / "TPU_VALIDATION.json"
     # merge-update: opt-in sections (e.g. the 32k long-context record)
     # must survive runs that don't re-validate them
