@@ -96,6 +96,11 @@ def _render_classes(labels, k: int, q: float, rng) -> np.ndarray:
     stays. Because a flip never lands back on the labeled class, the
     top-1 error floor is exactly ``q`` — the calibrated overlap behind
     ``label_noise``."""
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(
+            f"label_noise={q} must be in [0, 1] — it IS the top-1 error "
+            "floor the calibrated eval asserts against"
+        )
     render = labels.copy()
     if q and k > 1:
         flip = rng.random(len(labels)) < q

@@ -44,7 +44,7 @@ class KVCache:
     no autoregressive models).
 
     With ``kv_dtype="int8"`` the buffers hold per-position symmetric int8
-    with (L, B, H, S_max, 1) scales: at long context the cache, not the
+    with (L, B, KV_heads, S_max, 1) scales: at long context the cache, not the
     weights, dominates each decode step's HBM reads, and the scales pull
     OUT of both dots exactly (scores = (q·k_q^T)·scale_k; out =
     (p·scale_v)·v_q), so nothing dequantized ever materializes."""

@@ -307,6 +307,7 @@ def train(
                 "compute_dtype": model.compute_dtype,
                 "pos_encoding": model.pos_encoding,
                 "remat": model.remat,
+                "remat_policy": model.remat_policy,
                 "moe_aux_weight": model.moe_aux_weight,
                 "moe_experts": [
                     None if m is None else m.num_experts
@@ -334,6 +335,8 @@ def train(
                 "grad_clip": 0.0,
                 # pre-chunked-CE checkpoints were all dense
                 "logit_chunk": 0,
+                # pre-policy checkpoints always full-rematerialized
+                "remat_policy": "full",
                 # pre-GQA checkpoints were all MHA
                 "num_kv_heads": model.num_heads,
             },

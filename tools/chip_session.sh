@@ -19,7 +19,7 @@ log "2/5 mfu_sweep"
 timeout 1800 python tools/mfu_sweep.py || log "mfu_sweep FAILED ($?)"
 
 log "3/5 flash block sweep (long-context MFU lever)"
-timeout 3600 python tools/flash_sweep.py || log "flash_sweep FAILED ($?)"
+timeout 4500 python tools/flash_sweep.py || log "flash_sweep FAILED ($?)"
 
 log "4/5 tpu_validate (incl. 32k long-context fwd + train probes)"
 TPU_VALIDATE_LONG=1 timeout 3600 python tools/tpu_validate.py \

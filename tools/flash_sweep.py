@@ -56,6 +56,9 @@ def _write(results) -> dict:
     art = {
         "workload": "lm_longctx16k train step (bench shapes)",
         "results": results,
+        "configs_total": len(CONFIGS),
+        "configs_run": len(results),
+        "truncated": len(results) < len(CONFIGS),
         "best": best,
         "timestamp": datetime.datetime.now(
             datetime.timezone.utc
@@ -83,7 +86,7 @@ def main() -> None:
                 env=env,
                 capture_output=True,
                 text=True,
-                timeout=900,
+                timeout=600,
             )
             line = next(
                 (

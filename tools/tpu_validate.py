@@ -547,7 +547,7 @@ def validate_long_decode(results):
     # int8 codes streamed per decode step — K AND V buffers, shapes
     # derived from the model so the record can't desync from create()
     n_layers = len(model.blocks)
-    hd = 512 // model.num_heads
+    hd = model.embed.shape[-1] // model.num_heads
     s_max = s_prompt + new
     cache_mb = 2 * n_layers * 1 * model.kv_heads * s_max * hd / 1e6
     results["serve_16k_gqa_int8kv"] = {
