@@ -119,6 +119,11 @@ def mm_fused(
         out_specs=pl.BlockSpec((m_pad, block_n), lambda n, k: (0, n)),
         out_shape=jax.ShapeDtypeStruct((m_pad, n_pad), jnp.float32),
         scratch_shapes=[pltpu.VMEM((m_pad, block_n), jnp.float32)],
+        # N tiles are independent; K is the sequential accumulator dim —
+        # telling Mosaic lets it pipeline the int8 HBM loads across steps
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
         interpret=interpret,
     )(ym, q, s)
     out = out[:m, : w.q.shape[1]]
