@@ -22,6 +22,13 @@ from keystone_tpu.core.config import arg, parse_config
 from keystone_tpu.core.logging import get_logger
 from keystone_tpu.evaluation import MulticlassClassifierEvaluator
 from keystone_tpu.loaders.image_loaders import load_imagenet
+from keystone_tpu.loaders.imagenet_stream import (
+    assemble_global as _assemble_global,
+    render_classes as _render_classes,
+    synthetic_centers as _synthetic_centers,
+    synthetic_source as _synthetic_source,
+    tar_source as _tar_source,
+)
 from keystone_tpu.models.fisher_common import FisherBranch
 from keystone_tpu.ops.images import GrayScaler, PixelScaler
 from keystone_tpu.ops.lcs import LCSExtractor
@@ -81,34 +88,6 @@ class ImageNetConfig:
     stream_batch: int = arg(default=256, help="host images per stream batch")
 
 
-def _synthetic_centers(k: int) -> np.ndarray:
-    """The (k, 8, 8, 3) class centers every synthetic path shares (eager
-    load, streaming source, and the calibration test in
-    tests/test_streaming.py)."""
-    return np.random.default_rng(42).normal(
-        loc=128, scale=30, size=(k, 8, 8, 3)
-    )
-
-
-def _render_classes(labels, k: int, q: float, rng) -> np.ndarray:
-    """Class index each synthetic image is RENDERED from: with
-    probability ``q`` a uniformly random OTHER class, while the label
-    stays. Because a flip never lands back on the labeled class, the
-    top-1 error floor is exactly ``q`` — the calibrated overlap behind
-    ``label_noise``."""
-    if not 0.0 <= q <= 1.0:
-        raise ValueError(
-            f"label_noise={q} must be in [0, 1] — it IS the top-1 error "
-            "floor the calibrated eval asserts against"
-        )
-    render = labels.copy()
-    if q and k > 1:
-        flip = rng.random(len(labels)) < q
-        other = (labels + rng.integers(1, k, size=len(labels))) % k
-        render[flip] = other[flip]
-    return render
-
-
 def _load(conf: ImageNetConfig, which: str) -> tuple[LabeledImages, int]:
     if conf.synthetic:
         k = conf.synthetic_classes
@@ -140,103 +119,6 @@ def _descriptor_cols(desc) -> np.ndarray:
     """(N, d, m) device descriptors → (N·m, d) host rows for the reservoir."""
     n, d, m = desc.shape
     return np.asarray(jnp.transpose(desc, (0, 2, 1)).reshape(n * m, d))
-
-
-def _tar_source(conf: ImageNetConfig, which: str):
-    """Re-streamable batch source over the tar corpus: each call returns a
-    fresh iterator of (images, labels) host batches (this process's share
-    of the tar files)."""
-    import jax as _jax
-
-    from keystone_tpu.loaders.image_loaders import (
-        load_class_map,
-        make_synset_label_of,
-    )
-    from keystone_tpu.loaders.streaming import iter_tar_image_batches
-
-    label_of = make_synset_label_of(load_class_map(conf.label_map))
-    location = conf.train_location if which == "train" else conf.test_location
-
-    def source():
-        for _, imgs, labels in iter_tar_image_batches(
-            location,
-            batch_size=conf.stream_batch,
-            target_size=conf.image_size,
-            label_of=label_of,
-            process_index=_jax.process_index(),
-            process_count=_jax.process_count(),
-        ):
-            yield imgs, labels
-
-    return source
-
-
-def _synthetic_source(conf: ImageNetConfig, which: str):
-    """Serve the synthetic corpus through the streaming iterator contract.
-
-    Batches are generated LAZILY and deterministically (per-batch rngs):
-    at ImageNet scale the eager `_load` corpus would be ~80GB of host RAM
-    for 100k 256² images — materializing it would defeat the bounded-
-    memory property the streaming path exists to provide. Same
-    distribution as `_load` (shared class centers, per-batch noise), so
-    small-scale tests that compare against the eager path stay valid.
-    """
-    k = conf.synthetic_classes
-    n = conf.synthetic if which == "train" else max(conf.synthetic // 4, 1)
-    seed = 0 if which == "train" else 1
-    centers = _synthetic_centers(k)
-    up = conf.image_size // 8
-
-    def source():
-        for s in range(0, n, conf.stream_batch):
-            b = min(conf.stream_batch, n - s)
-            rng = np.random.default_rng((seed, s))
-            labels = rng.integers(0, k, size=b).astype(np.int32)
-            render = _render_classes(labels, k, conf.label_noise, rng)
-            imgs = np.kron(centers[render], np.ones((1, up, up, 1)))
-            imgs += rng.normal(scale=20, size=imgs.shape)
-            yield np.clip(imgs, 0, 255).astype(np.float32), labels
-
-    return source
-
-
-def _assemble_global(features: np.ndarray, labels: np.ndarray):
-    """Combine every process's local (n_p, D) features + labels into the
-    global training set (each process streamed a disjoint tar shard).
-
-    Features are small relative to images (the whole point of streaming),
-    so an allgather-and-concatenate keeps the solver's simple
-    prefix-validity contract — the same host footprint the eager path
-    already pays for its feature matrix. Single-process: passthrough.
-    """
-    import jax as _jax
-
-    if _jax.process_count() == 1:
-        return features, labels
-    from jax.experimental import multihost_utils
-
-    # gather count AND width: a process whose tar shard was empty (or all
-    # undecodable) holds a (0, 0) feature array, and allgather needs
-    # identical shapes across processes
-    meta = multihost_utils.process_allgather(
-        np.asarray([len(features), features.shape[-1]], np.int64)
-    ).reshape(-1, 2)
-    counts, dims = meta[:, 0], meta[:, 1]
-    n_max = int(counts.max())
-    dim = int(dims.max())
-    pad_f = np.zeros((n_max, dim), np.float32)
-    pad_f[: len(features), : features.shape[-1]] = features
-    pad_y = np.zeros((n_max,), np.int32)
-    pad_y[: len(labels)] = labels
-    all_f = multihost_utils.process_allgather(pad_f)  # (P, n_max, D)
-    all_y = multihost_utils.process_allgather(pad_y)
-    feats = np.concatenate(
-        [all_f[p, : counts[p]] for p in range(len(counts))]
-    )
-    labs = np.concatenate(
-        [all_y[p, : counts[p]] for p in range(len(counts))]
-    )
-    return feats, labs
 
 
 def run_streaming(

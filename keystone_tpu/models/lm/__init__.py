@@ -1,7 +1,8 @@
 """Decoder-only transformer LM, split by lifecycle:
 
-- :mod:`.model` — architecture (TransformerLM/LMBlock), TP layout,
-  losses, analytic FLOPs;
+- :mod:`.model` — architecture (TransformerLM/LMBlock), analytic FLOPs;
+- :mod:`.losses` — next-token CE, dense and logit-chunked;
+- :mod:`.sharding` — the Megatron-style tensor-parallel weight layout;
 - :mod:`.train` — optimizers, the jitted dp/tp and pipeline-parallel
   train steps, the checkpointed training loop, corpora;
 - :mod:`.decode` — KV-cache serving: prefill, decode, sampling,
@@ -18,15 +19,17 @@ from keystone_tpu.models.lm.decode import (
     prefill,
     quantize_for_decode,
 )
+from keystone_tpu.models.lm.losses import (
+    chunked_token_cross_entropy,
+    next_token_loss,
+    token_cross_entropy,
+)
 from keystone_tpu.models.lm.model import (
     LMBlock,
     TransformerLM,
-    chunked_token_cross_entropy,
-    next_token_loss,
-    shard_params,
-    token_cross_entropy,
     train_step_flops,
 )
+from keystone_tpu.models.lm.sharding import shard_params
 from keystone_tpu.models.lm.train import (
     make_optimizer,
     make_pp_train_step,

@@ -1,0 +1,75 @@
+"""Next-token losses for the transformer LM.
+
+The numerically sensitive ``logsumexp − gold`` form lives ONCE here
+(:func:`token_cross_entropy`); the chunked variant reduces the CE in
+S-chunks so the (B, S, V) f32 logits never materialize — at long
+context that tensor is the step's single largest HBM object
+(S=16k × V=32k f32 = 2.1 GB, twice more with its gradient).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from keystone_tpu.models.lm.model import TransformerLM, _tied_logits
+
+
+def token_cross_entropy(logits, targets) -> jnp.ndarray:
+    """Mean next-token cross-entropy. logits: (B, S, V) f32; targets:
+    (B, S) int. The single source of the numerically sensitive
+    ``logsumexp - gold`` form, shared by training loss and evaluation."""
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)
+
+
+def chunked_token_cross_entropy(x, embed, targets, cdt, chunk: int):
+    """Mean next-token CE from final hidden states without ever holding
+    the (B, S, V) f32 logits: positions are processed in S-chunks — each
+    chunk's logits are built, reduced to ``logsumexp − gold``, and
+    dropped (``jax.checkpoint`` recomputes them in the backward),
+    turning the full logits tensor into a ``chunk`` × V working set."""
+    b, s, d = x.shape
+    if chunk <= 0 or s % chunk:
+        raise ValueError(
+            f"logit_chunk={chunk} must be a positive divisor of the "
+            f"sequence length {s}"
+        )
+    n_c = s // chunk
+    xc = x.reshape(b, n_c, chunk, d).transpose(1, 0, 2, 3)
+    tc = targets.reshape(b, n_c, chunk).transpose(1, 0, 2)
+
+    @jax.checkpoint
+    def chunk_sum(xx, tt):
+        logits = _tied_logits(xx, embed, cdt)  # (B, chunk, V) f32
+        # token_cross_entropy stays the single source of the CE form;
+        # mean × count turns it back into this chunk's sum exactly
+        return token_cross_entropy(logits, tt) * tt.size
+
+    total, _ = jax.lax.scan(
+        lambda c, args: (c + chunk_sum(*args), None),
+        jnp.float32(0),
+        (xc, tc),
+    )
+    return total / (b * s)
+
+
+def next_token_loss(
+    model: TransformerLM, tokens, logit_chunk: int = 0
+) -> jnp.ndarray:
+    """Mean cross-entropy of predicting ``tokens[:, 1:]`` from the prefix
+    (the model runs on the first S tokens of an S+1 window), plus the
+    weighted MoE load-balance auxiliary when the model routes.
+    ``logit_chunk > 0`` computes the CE in S-chunks so the full (B, S, V)
+    f32 logits never materialize (see chunked_token_cross_entropy)."""
+    if logit_chunk:
+        cdt = jnp.dtype(model.compute_dtype)
+        x, aux = model.backbone(tokens[:, :-1])
+        ce = chunked_token_cross_entropy(
+            x, model.embed, tokens[:, 1:], cdt, logit_chunk
+        )
+        return ce + model.moe_aux_weight * aux
+    logits, aux = model.forward_with_aux(tokens[:, :-1])
+    ce = token_cross_entropy(logits, tokens[:, 1:])
+    return ce + model.moe_aux_weight * aux

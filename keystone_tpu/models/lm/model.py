@@ -24,7 +24,6 @@ This is a beyond-reference capability in the same spirit as
 
 from __future__ import annotations
 
-import dataclasses
 import math
 
 import jax
@@ -419,66 +418,6 @@ class TransformerLM:
         )
 
 
-def shard_params(model: TransformerLM, mesh) -> TransformerLM:
-    """Lay the weights out for tensor parallelism over the mesh ``model``
-    axis: attention q/k/v column-sharded (head-parallel) with wo
-    row-sharded, MLP column- then row-sharded, embedding vocab-sharded.
-    XLA then inserts exactly the two psums per block that hand-written
-    Megatron-style TP would — the layout IS the parallelism.
-    """
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    if mesh is None or mesh.shape.get("model", 1) == 1:
-        return model
-    n_model = mesh.shape["model"]
-
-    def put(x, spec):
-        # a dim not divisible by the axis (e.g. an unpadded vocab) is
-        # replicated rather than rejected
-        spec = P(
-            *(
-                a
-                if a is None or x.shape[i] % n_model == 0
-                else None
-                for i, a in enumerate(spec)
-            )
-        )
-        return jax.device_put(x, NamedSharding(mesh, spec))
-
-    blocks = tuple(
-        LMBlock(
-            wq=put(b.wq, P(None, "model")),
-            wk=put(b.wk, P(None, "model")),
-            wv=put(b.wv, P(None, "model")),
-            wo=put(b.wo, P("model", None)),
-            w1=put(b.w1, P(None, "model")),
-            w2=put(b.w2, P("model", None)),
-        )
-        for b in model.blocks
-    )
-    moes = tuple(
-        m
-        if m is None
-        else dataclasses.replace(
-            m,
-            # expert-parallel: one expert group per model-axis device;
-            # the router stays replicated (every token scores every
-            # expert) — XLA places the dispatch/combine all_to_alls
-            w_router=put(m.w_router, P()),
-            w1=put(m.w1, P("model", None, None)),
-            w2=put(m.w2, P("model", None, None)),
-        )
-        for m in model.moe_layers
-    )
-    return dataclasses.replace(
-        model,
-        embed=put(model.embed, P("model", None)),
-        pos_embed=put(model.pos_embed, P()),
-        blocks=blocks,
-        moe_layers=moes,
-    )
-
-
 def remat_wrap(fn, policy: str):
     """``jax.checkpoint`` under the model's remat policy (shared by the
     layer loop and the pipeline-parallel stage chain)."""
@@ -490,68 +429,6 @@ def remat_wrap(fn, policy: str):
             policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
         )
     raise ValueError(f"remat_policy={policy!r}; expected full|dots")
-
-
-def token_cross_entropy(logits, targets) -> jnp.ndarray:
-    """Mean next-token cross-entropy. logits: (B, S, V) f32; targets:
-    (B, S) int. The single source of the numerically sensitive
-    ``logsumexp - gold`` form, shared by training loss and evaluation."""
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(logz - gold)
-
-
-def chunked_token_cross_entropy(x, embed, targets, cdt, chunk: int):
-    """Mean next-token CE from final hidden states without ever holding
-    the (B, S, V) f32 logits: positions are processed in S-chunks — each
-    chunk's logits are built, reduced to ``logsumexp − gold``, and
-    dropped (``jax.checkpoint`` recomputes them in the backward). At
-    long context the full logits tensor is the step's single largest
-    HBM object (S=16k × V=32k f32 = 2.1 GB, twice more with its grad);
-    chunking turns that into ``chunk`` × V working set."""
-    b, s, d = x.shape
-    if chunk <= 0 or s % chunk:
-        raise ValueError(
-            f"logit_chunk={chunk} must be a positive divisor of the "
-            f"sequence length {s}"
-        )
-    n_c = s // chunk
-    xc = x.reshape(b, n_c, chunk, d).transpose(1, 0, 2, 3)
-    tc = targets.reshape(b, n_c, chunk).transpose(1, 0, 2)
-
-    @jax.checkpoint
-    def chunk_sum(xx, tt):
-        logits = _tied_logits(xx, embed, cdt)  # (B, chunk, V) f32
-        # token_cross_entropy stays the single source of the CE form;
-        # mean × count turns it back into this chunk's sum exactly
-        return token_cross_entropy(logits, tt) * tt.size
-
-    total, _ = jax.lax.scan(
-        lambda c, args: (c + chunk_sum(*args), None),
-        jnp.float32(0),
-        (xc, tc),
-    )
-    return total / (b * s)
-
-
-def next_token_loss(
-    model: TransformerLM, tokens, logit_chunk: int = 0
-) -> jnp.ndarray:
-    """Mean cross-entropy of predicting ``tokens[:, 1:]`` from the prefix
-    (the model runs on the first S tokens of an S+1 window), plus the
-    weighted MoE load-balance auxiliary when the model routes.
-    ``logit_chunk > 0`` computes the CE in S-chunks so the full (B, S, V)
-    f32 logits never materialize (see chunked_token_cross_entropy)."""
-    if logit_chunk:
-        cdt = jnp.dtype(model.compute_dtype)
-        x, aux = model.backbone(tokens[:, :-1])
-        ce = chunked_token_cross_entropy(
-            x, model.embed, tokens[:, 1:], cdt, logit_chunk
-        )
-        return ce + model.moe_aux_weight * aux
-    logits, aux = model.forward_with_aux(tokens[:, :-1])
-    ce = token_cross_entropy(logits, tokens[:, 1:])
-    return ce + model.moe_aux_weight * aux
 
 
 def has_quantized_leaves(model) -> bool:

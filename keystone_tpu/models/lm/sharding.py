@@ -1,0 +1,79 @@
+"""Tensor-parallel weight layout for the transformer LM.
+
+The layout IS the parallelism: annotate each weight's sharding over the
+mesh ``model`` axis and XLA inserts exactly the two psums per block that
+hand-written Megatron-style TP would (see shard_params). The same layout
+feeds the pipeline-parallel path unchanged — gpipe leaves non-manual
+mesh axes automatic, so these shardings propagate into stage bodies on a
+3-axis (pipe, data, model) mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+
+from keystone_tpu.models.lm.model import LMBlock, TransformerLM
+
+
+def shard_params(model: TransformerLM, mesh) -> TransformerLM:
+    """Lay the weights out for tensor parallelism over the mesh ``model``
+    axis: attention q/k/v column-sharded (head-parallel) with wo
+    row-sharded, MLP column- then row-sharded, embedding vocab-sharded.
+    XLA then inserts exactly the two psums per block that hand-written
+    Megatron-style TP would — the layout IS the parallelism.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if mesh is None or mesh.shape.get("model", 1) == 1:
+        return model
+    n_model = mesh.shape["model"]
+
+    def put(x, spec):
+        # a dim not divisible by the axis (e.g. an unpadded vocab) is
+        # replicated rather than rejected
+        spec = P(
+            *(
+                a
+                if a is None or x.shape[i] % n_model == 0
+                else None
+                for i, a in enumerate(spec)
+            )
+        )
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    blocks = tuple(
+        LMBlock(
+            wq=put(b.wq, P(None, "model")),
+            wk=put(b.wk, P(None, "model")),
+            wv=put(b.wv, P(None, "model")),
+            wo=put(b.wo, P("model", None)),
+            w1=put(b.w1, P(None, "model")),
+            w2=put(b.w2, P("model", None)),
+        )
+        for b in model.blocks
+    )
+    moes = tuple(
+        m
+        if m is None
+        else dataclasses.replace(
+            m,
+            # expert-parallel: one expert group per model-axis device;
+            # the router stays replicated (every token scores every
+            # expert) — XLA places the dispatch/combine all_to_alls
+            w_router=put(m.w_router, P()),
+            w1=put(m.w1, P("model", None, None)),
+            w2=put(m.w2, P("model", None, None)),
+        )
+        for m in model.moe_layers
+    )
+    return dataclasses.replace(
+        model,
+        embed=put(model.embed, P("model", None)),
+        pos_embed=put(model.pos_embed, P()),
+        blocks=blocks,
+        moe_layers=moes,
+    )
+
+

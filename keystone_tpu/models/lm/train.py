@@ -17,14 +17,16 @@ import numpy as np
 import optax
 
 from keystone_tpu.core.logging import get_logger
+from keystone_tpu.models.lm.losses import (
+    next_token_loss,
+    token_cross_entropy,
+)
 from keystone_tpu.models.lm.model import (
     TransformerLM,
     _block_apply,
     _embed,
     _tied_logits,
     has_quantized_leaves,
-    next_token_loss,
-    token_cross_entropy,
 )
 
 logger = get_logger("keystone_tpu.models.lm_transformer")
@@ -144,7 +146,7 @@ def make_train_step(optimizer, *, logit_chunk: int = 0):
     """One buffer-donated jitted program: grads + AdamW update + loss.
     ``logit_chunk`` chunks the CE so the (B, S, V) f32 logits never
     materialize (the long-context memory/bandwidth lever — see
-    :func:`keystone_tpu.models.lm.model.chunked_token_cross_entropy`)."""
+    :func:`keystone_tpu.models.lm.losses.chunked_token_cross_entropy`)."""
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(model, opt_state, tokens):
