@@ -206,6 +206,7 @@ def test_success_persists_tpu_record(monkeypatch, tmp_path, capsys):
         lambda: {
             "decode_tokens_per_s": 2.0,
             "decode_int8_tokens_per_s": 3.0,
+            "decode_int8_pallas_tokens_per_s": 4.0,
         },
     )
     monkeypatch.setattr(
