@@ -209,6 +209,8 @@ def _filter_logits(logits, top_k: int, top_p: float):
     Static-shape throughout, one descending sort shared by both filters.
     """
     v = logits.shape[-1]
+    if top_k < 0 or top_k > v:
+        raise ValueError(f"top_k={top_k} outside [0, vocab={v}]")
     sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
     if top_k:
         kth = sorted_l[:, top_k - 1][:, None]

@@ -193,7 +193,7 @@ def _ring_trainable_fwd(q, k, v, axis_name, causal, use_flash):
 
 
 def _ring_trainable_bwd(axis_name, causal, use_flash, res, g):
-    from keystone_tpu.ops.flash_attention import _BWD_BLOCK, _grads_rect
+    from keystone_tpu.ops.flash_attention import _bwd_block, _grads_rect
 
     q, k, v, out, lse = res
     n = lax.axis_size(axis_name)
@@ -205,7 +205,8 @@ def _ring_trainable_bwd(axis_name, causal, use_flash, res, g):
     gf = g.astype(jnp.float32)
     delta = jnp.sum(gf * out.astype(jnp.float32), axis=-1)
 
-    blk = _BWD_BLOCK if s_local > _BWD_BLOCK else -(-s_local // 8) * 8
+    bwd_block = _bwd_block()
+    blk = bwd_block if s_local > bwd_block else -(-s_local // 8) * 8
     pad = -(-s_local // blk) * blk - s_local
 
     dq = jnp.zeros((b, h, s_local, d), jnp.float32)

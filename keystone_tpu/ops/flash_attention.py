@@ -586,7 +586,12 @@ def _env_int(name: str, default: int) -> int:
 # tensors (~4 of them, f32, per (b, h)): above this the blockwise
 # O(S·block) backward takes over
 _DENSE_BWD_MAX_BYTES = 4 << 30
-_BWD_BLOCK = _env_int("KST_FLASH_BWD_BLOCK", 512)
+
+
+def _bwd_block() -> int:
+    # read per call, like the forward block_q/block_k pair — setting
+    # KST_FLASH_BWD_BLOCK after import must take effect (a tuner knob)
+    return _env_int("KST_FLASH_BWD_BLOCK", 512)
 
 
 def _dense_bwd_bytes(q, k) -> int:
@@ -611,7 +616,8 @@ def _bwd_mask(q_pos, k_pos, s_k_valid, causal: bool):
 # More chunks → closer to the ideal 0.5·S² triangle (n chunks execute
 # (n+1)/2n of the rectangle) at the cost of shorter scans; 8 is a good
 # regular-pipelining compromise (0.5625·S²)
-_BWD_CAUSAL_CHUNKS = _env_int("KST_FLASH_BWD_CHUNKS", 8)
+def _bwd_causal_chunks() -> int:
+    return _env_int("KST_FLASH_BWD_CHUNKS", 8)
 
 
 def _grads_rect(qf, kp, vp, gf, delta, lse, q_off, s_k_valid, causal, block,
@@ -679,7 +685,7 @@ def _blockwise_grads(q, k, v, g, out, lse, causal: bool, block: int):
 
     # causal (s_q == s_k enforced by the trainable wrapper): chunk edges
     # in whole K blocks so each chunk's live prefix is block-aligned
-    n_chunks = min(_BWD_CAUSAL_CHUNKS, nb)
+    n_chunks = min(_bwd_causal_chunks(), nb)
     edges = sorted({round(nb * c / n_chunks) for c in range(n_chunks + 1)})
     dq_parts = []
     dk = jnp.zeros((b, h, nb * block, d), jnp.float32)
@@ -761,7 +767,7 @@ def _flash_trainable_bwd(causal: bool, res, g):
             lambda q, k, v: dense_attention(q, k, v, causal=causal), q, k, v
         )
         return vjp(g)
-    return _blockwise_grads(q, k, v, g, out, lse, causal, _BWD_BLOCK)
+    return _blockwise_grads(q, k, v, g, out, lse, causal, _bwd_block())
 
 
 flash_attention_trainable.defvjp(_flash_trainable_fwd, _flash_trainable_bwd)

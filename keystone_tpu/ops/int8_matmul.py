@@ -31,7 +31,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from keystone_tpu.ops.quantization import QTensor
+from keystone_tpu.ops.quantization import QTensor, mm as _xla_mm
 
 
 def _kernel(y_ref, q_ref, s_ref, o_ref, acc_ref, *, n_k: int):
@@ -58,6 +58,12 @@ def _kernel(y_ref, q_ref, s_ref, o_ref, acc_ref, *, n_k: int):
     @pl.when(k == n_k - 1)
     def _finalize():
         o_ref[...] = acc_ref[...] * s_ref[...]
+
+
+# Largest M the single-tile layout may carry: (M, block_n) f32 scratch +
+# (M, block_k) activation tile stay well under ~1 MB of VMEM at the
+# default 512 blocks. Decode uses M = batch ≤ 64; 256 leaves headroom.
+_MAX_M = 256
 
 
 def _pad_dim(x, axis: int, mult: int):
@@ -99,8 +105,12 @@ def mm_fused(
     m = ym.shape[0]
     # MXU-friendly tiles: M to the 16-sublane tile, K/N to blocks. The
     # whole M extent rides in one tile (plus an (M, block_n) scratch) —
-    # this kernel is for decode's tiny-M regime; callers keep large-M
-    # shapes on the XLA path (see models/lm/model.model_mm)
+    # this kernel is for decode's tiny-M regime, so the decode-only
+    # contract is enforced here: past _MAX_M the full-M activation tile
+    # + f32 scratch would blow VMEM, so fall back to the XLA path
+    # rather than leave the guard to callers (models/lm/model.model_mm)
+    if m > _MAX_M:
+        return _xla_mm(y, w, y.dtype)
     ym = _pad_dim(_pad_dim(ym, 0, 16), 1, block_k)
     q = _pad_dim(_pad_dim(w.q, 0, block_k), 1, block_n)
     s = _pad_dim(w.scale.astype(jnp.float32), 1, block_n)

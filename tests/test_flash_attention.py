@@ -171,7 +171,7 @@ def test_blockwise_backward_matches_dense_grads(rng, causal, s, monkeypatch):
     import keystone_tpu.ops.flash_attention as fa
 
     monkeypatch.setattr(fa, "_DENSE_BWD_MAX_BYTES", 0)
-    monkeypatch.setattr(fa, "_BWD_BLOCK", 256)
+    monkeypatch.setenv("KST_FLASH_BWD_BLOCK", "256")
     q, k, v = (
         jnp.asarray(rng.normal(size=(2, 3, s, 32)).astype(np.float32))
         for _ in range(3)

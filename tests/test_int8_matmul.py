@@ -37,6 +37,16 @@ def test_mm_fused_matches_mm(rng, m, k, n):
         np.testing.assert_allclose(got, want, rtol=tol, atol=tol)
 
 
+def test_mm_fused_large_m_falls_back_to_xla(rng):
+    """Past the decode-regime M cap the kernel's single-tile layout would
+    blow VMEM; mm_fused must route to the XLA path, not crash."""
+    qt = quantize_int8(jnp.asarray(rng.normal(size=(128, 96)).astype(np.float32)))
+    y = jnp.asarray(rng.normal(size=(300, 128)).astype(np.float32))
+    got = mm_fused(y, qt, block_n=128, block_k=128, interpret=True)
+    want = mm(y, qt, jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
 def test_mm_fused_batched_leading_dims(rng):
     qt = quantize_int8(jnp.asarray(rng.normal(size=(128, 96)).astype(np.float32)))
     y = jnp.asarray(rng.normal(size=(2, 3, 128)).astype(np.float32))

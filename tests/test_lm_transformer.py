@@ -304,6 +304,12 @@ def test_topk_topp_sampling():
     )
     arr = np.asarray(free)
     assert arr.shape == (1, 8) and arr.min() >= 0 and arr.max() < 31
+    # top_k beyond the vocab is a config error, not a silent clamp
+    with pytest.raises(ValueError, match="top_k"):
+        lm.generate(
+            model, prompt, max_new=2, temperature=1.0, top_k=1000,
+            key=jax.random.key(1),
+        )
 
 
 def test_pp_forward_matches_local():
