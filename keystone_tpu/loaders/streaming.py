@@ -144,12 +144,66 @@ class ColumnReservoir:
         return self.buf[: self.filled]
 
 
+def prefetch_batches(batches: Iterable, depth: int = 2) -> Iterator:
+    """Run a batch producer on a background thread with a bounded queue.
+
+    Tar/JPEG decode (or synthetic rendering) is pure host work; putting
+    the producer one thread over lets it decode batch k+1 while the
+    device featurizes batch k (the decode path releases the GIL inside
+    PIL/numpy). ``depth`` bounds host memory to that many batches in
+    flight. Exceptions from the producer re-raise at the consumer."""
+    import queue
+    import threading
+
+    q: queue.Queue = queue.Queue(maxsize=max(depth, 1))
+    end = object()
+    stop = threading.Event()  # consumer gone — unblock + retire producer
+
+    def put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.5)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def worker():
+        try:
+            for b in batches:
+                if not put(b):
+                    return
+            put(end)
+        except BaseException as e:  # noqa: BLE001 — re-raised at consumer
+            put(e)
+
+    threading.Thread(target=worker, daemon=True).start()
+
+    def gen():
+        # the finally runs on close()/GC of an abandoned generator (e.g.
+        # the featurizer raised mid-stream), so the producer never stays
+        # parked in q.put holding decoded batches + the source handle
+        try:
+            while True:
+                item = q.get()
+                if item is end:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+
+    return gen()
+
+
 def featurize_stream(
     batches: Iterable[np.ndarray],
     fn: Callable,
     *,
     chunk_size: int,
     mesh=None,
+    prefetch: int = 2,
 ) -> np.ndarray:
     """Apply a jitted featurizer to a stream of host batches.
 
@@ -159,8 +213,23 @@ def featurize_stream(
     chunk is placed data-sharded across the mesh before the call. Only
     the (small) feature output accumulates on the host — peak memory is
     one image chunk plus the features, never the corpus.
-    """
+
+    ``prefetch`` bounds in-flight device work: up to that many chunk
+    results stay un-forced, so the host moves on to decoding/padding the
+    next chunk while the device computes (JAX dispatch is async — it is
+    the ``np.asarray`` force that blocks). The producer side overlaps
+    too when the caller wraps its iterator in :func:`prefetch_batches`.
+    ``prefetch=0`` restores the fully synchronous round-trip."""
+    from collections import deque
+
     outs = []
+    inflight: deque = deque()  # (device result, valid rows)
+
+    def drain(limit: int):
+        while len(inflight) > limit:
+            out, valid = inflight.popleft()
+            outs.append(np.asarray(out)[:valid])
+
     for batch in batches:
         for start in range(0, len(batch), chunk_size):
             chunk = np.asarray(batch[start : start + chunk_size])
@@ -172,7 +241,9 @@ def featurize_stream(
                 from keystone_tpu.parallel.mesh import shard_batch
 
                 chunk = shard_batch(chunk, mesh)
-            outs.append(np.asarray(fn(chunk))[:valid])
+            inflight.append((fn(chunk), valid))
+            drain(max(prefetch, 0))
+    drain(0)
     if not outs:
         return np.zeros((0, 0), np.float32)
     return np.concatenate(outs, axis=0)

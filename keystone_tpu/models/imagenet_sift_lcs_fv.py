@@ -205,7 +205,10 @@ def run_streaming(
     )
 
     def features_labels_of(source):
-        from keystone_tpu.loaders.streaming import featurize_stream
+        from keystone_tpu.loaders.streaming import (
+            featurize_stream,
+            prefetch_batches,
+        )
 
         label_parts: list[np.ndarray] = []
 
@@ -214,8 +217,10 @@ def run_streaming(
                 label_parts.append(np.asarray(labels, np.int32))
                 yield imgs
 
+        # decode-ahead thread + bounded in-flight device chunks: host
+        # decode of batch k+1 overlaps the device featurize of batch k
         feats = featurize_stream(
-            image_batches(), featurize_chunk,
+            prefetch_batches(image_batches(), depth=2), featurize_chunk,
             chunk_size=conf.chunk_size, mesh=mesh,
         )
         labels = (

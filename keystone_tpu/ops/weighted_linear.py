@@ -56,6 +56,16 @@ from keystone_tpu.ops.linear import (
 # 2 · C · d_block² · 4B must fit alongside the rest of the fit
 _DENSE_HOIST_BUDGET = 2 << 30
 
+# transient-HBM budget (bytes) for one Woodbury solve group. The Woodbury
+# path never forms d² per-class matrices — its working set is the
+# (S, d, L+1) v/y slices — so chunking it by ``class_chunk`` (sized for
+# the dense path's chunk·d² solves) over-serializes the per-pass solves
+# into tiny sequential lax.map steps whose launch/loop overhead dwarfs
+# their gemms. Classes are instead grouped to fill this budget (v + y +
+# ~4 same-sized transients per class), which solves TIMIT (C=147) in one
+# batched step and ImageNet (C=1000) in two.
+_WOODBURY_CHUNK_BUDGET = 4 << 30
+
 
 @treenode
 class BlockWeightedLeastSquaresEstimator(LabelEstimator):
@@ -233,6 +243,14 @@ def _weighted_bcd_fit(
     n_rows = blocks[0].shape[0]
     n = jnp.sum(mask)
 
+    if class_l is not None:
+        # grid mode: every gathered row is either a real (valid) row or
+        # the appended all-zero sentinel, so ``a * mask`` is an identity —
+        # skip it and save an N·d read+write per use per pass
+        masked_rows = lambda a: a  # noqa: E731
+    else:
+        masked_rows = lambda a: a * mask  # noqa: E731
+
     # one-hot class membership (argmax of ±1 indicators), padded rows zeroed
     if class_l is not None:
         class_idx = jnp.arange(n_rows) // class_l  # layout-defined
@@ -256,7 +274,8 @@ def _weighted_bcd_fit(
         # population mean; with it the fixed point matches the exact
         # weighted-ridge optimum on imbalanced data too (see
         # test_weighted_matches_exact_optimum).
-        return jnp.sum(r * mask, axis=0) / n  # (C,)
+        r_m = r if class_l is not None else r * mask
+        return jnp.sum(r_m, axis=0) / n  # (C,)
 
     res_mean = residual_mean(resid)
 
@@ -275,7 +294,7 @@ def _weighted_bcd_fit(
 
     pop_means, pop_covs, joint_means, b_invs = [], [], [], []
     for a in blocks:
-        a_m = a * mask
+        a_m = masked_rows(a)
         pop_mean = jnp.sum(a_m, axis=0) / n
         # covariance from CENTERED rows, not gram/n − μμᵀ: the
         # subtraction form loses |μ|²/|cov| digits to cancellation in
@@ -301,10 +320,24 @@ def _weighted_bcd_fit(
     n_chunks = -(-c // class_chunk)
     c_pad = n_chunks * class_chunk
 
-    def pad_classes(x, axis):
+    def chunk_grid(s_chunk):
+        """(classes per chunk, number of chunks) with s_chunk clamped."""
+        s_chunk = max(1, min(s_chunk, c))
+        return s_chunk, -(-c // s_chunk)
+
+    def pad_classes(x, axis, cp=None):
         pad = [(0, 0)] * x.ndim
-        pad[axis] = (0, c_pad - c)
+        pad[axis] = (0, (c_pad if cp is None else cp) - c)
         return jnp.pad(x, pad)
+
+    def map_chunks(f, xs, nch):
+        """lax.map over the leading chunk axis; a single chunk calls the
+        body directly (no one-trip loop standing between XLA and the
+        batched gemms)."""
+        if nch == 1:
+            squeezed = jax.tree_util.tree_map(lambda a: a[0], xs)
+            return jax.tree_util.tree_map(lambda a: a[None], f(squeezed))
+        return jax.lax.map(f, xs)
 
     xs = tuple(jnp.zeros((a.shape[-1], c), dtype) for a in blocks)
     if init_xs is not None:
@@ -312,7 +345,7 @@ def _weighted_bcd_fit(
         # residual in the consistent state R = (labels − mean) − Σ A_i x_i
         xs = tuple(x.astype(dtype) for x in init_xs)
         for blk_a, x in zip(blocks, xs):
-            resid = resid - (blk_a * mask) @ x
+            resid = resid - masked_rows(blk_a) @ x
         res_mean = residual_mean(resid)
 
     def chunk_rhs(s):
@@ -359,34 +392,52 @@ def _weighted_bcd_fit(
         for a in blocks
     ]
 
-    def class_static_stats(a_m):
+    def class_static_stats(a_m, s_chunk=None, nch=None):
         """Chunked pass-invariant per-class stats shared by the Woodbury
         prep, the dense prep, and the in-loop fallback: class means,
-        counts, and the class rows (grid) or one-hot columns (masked)."""
+        counts, and the class rows (grid) or one-hot columns (masked).
+        Chunk geometry defaults to the dense path's (class_chunk-sized);
+        the Woodbury path passes its own wider grouping."""
+        if s_chunk is None:
+            s_chunk, nch = class_chunk, n_chunks
+        cp = s_chunk * nch
         static = {
             "class_mean": pad_classes(
-                class_sum(a_m) / n_c_safe[:, None], 0
-            ).reshape(n_chunks, class_chunk, -1),
-            "n_c": pad_classes(n_c_safe, 0).reshape(n_chunks, class_chunk),
+                class_sum(a_m) / n_c_safe[:, None], 0, cp
+            ).reshape(nch, s_chunk, -1),
+            "n_c": pad_classes(n_c_safe, 0, cp).reshape(nch, s_chunk),
         }
         if class_l is not None:
             static["a_rows"] = pad_classes(
-                a_m.reshape(c, class_l, -1), 0
-            ).reshape(n_chunks, class_chunk, class_l, -1)
+                a_m.reshape(c, class_l, -1), 0, cp
+            ).reshape(nch, s_chunk, class_l, -1)
         else:
-            oh_chunks = pad_classes(onehot, 1).reshape(
-                n_rows, n_chunks, class_chunk
+            oh_chunks = pad_classes(onehot, 1, cp).reshape(
+                n_rows, nch, s_chunk
             )
             static["onehot"] = jnp.moveaxis(oh_chunks, 1, 0)
         return static
+
+    # Woodbury chunk geometry per block: group classes to fill the
+    # transient budget (v + y + ~4 like-sized transients per class of
+    # d·(L+1) floats) instead of the dense path's class_chunk
+    wood_chunks = [None] * len(blocks)
+    for i, a in enumerate(blocks):
+        if use_woodbury[i]:
+            per_class = 6 * a.shape[-1] * (class_l + 1) * np.dtype(
+                dtype
+            ).itemsize
+            wood_chunks[i] = chunk_grid(
+                max(int(_WOODBURY_CHUNK_BUDGET // per_class), class_chunk)
+            )
 
     wood_pre = []
     for i, a in enumerate(blocks):
         if not use_woodbury[i]:
             wood_pre.append(None)
             continue
-        a_m = a * mask
-        static = class_static_stats(a_m)
+        a_m = masked_rows(a)
+        static = class_static_stats(a_m, *wood_chunks[i])
         lp1 = class_l + 1
 
         def prep_chunk(s, b_inv=b_invs[i], pop_mean=pop_means[i], lp1=lp1):
@@ -437,7 +488,7 @@ def _weighted_bcd_fit(
             ginv = jax.vmap(_inv_spd)(g)
             return {"v": v, "y": y, "ginv": ginv}
 
-        wood_pre.append(jax.lax.map(prep_chunk, static))
+        wood_pre.append(map_chunks(prep_chunk, static, wood_chunks[i][1]))
 
     # DENSE-path hoisting: the per-class systems (class Grams + joint_xtx
     # + their factorizations) are pass-invariant too; for multi-pass fits
@@ -464,7 +515,7 @@ def _weighted_bcd_fit(
         if not hoist:
             dense_pre.append(None)
             continue
-        a_m = a * mask
+        a_m = masked_rows(a)
         static = class_static_stats(a_m)
 
         def prep_dense(
@@ -476,7 +527,7 @@ def _weighted_bcd_fit(
             fc, fs = jax.vmap(lambda m_: ridge_factor(m_, lam))(jxtx)
             return {"jxtx": jxtx, "c": fc, "s": fs}
 
-        dense_pre.append(jax.lax.map(prep_dense, static))
+        dense_pre.append(map_chunks(prep_dense, static, n_chunks))
 
     # one full BCD sweep (every block) per fori_loop step: the program is
     # traced/compiled ONCE per block regardless of num_iter (an unrolled
@@ -485,35 +536,52 @@ def _weighted_bcd_fit(
         xs, resid, res_mean = state
         xs = list(xs)
         for i, a in enumerate(blocks):
-            a_m = a * mask
+            a_m = masked_rows(a)
             pop_mean, pop_cov, joint_mean = (
                 pop_means[i], pop_covs[i], joint_means[i],
             )
             pop_xtr = (a_m.T @ resid) / n  # (d, C)
             # per-class residual stats restricted to own-class rows/column
-            r_own = jnp.sum(resid * onehot, axis=-1, keepdims=True)  # (N, 1)
+            if class_l is not None:
+                # grid mode: row (c, l)'s own-class column IS column c —
+                # a diagonal view of the (C, L, C) residual grid; skips
+                # materializing + streaming the N·C onehot per pass
+                r_own = jnp.take_along_axis(
+                    resid.reshape(c, class_l, c),
+                    jnp.arange(c)[:, None, None],
+                    axis=2,
+                ).reshape(-1, 1)  # (N, 1)
+            else:
+                r_own = jnp.sum(
+                    resid * onehot, axis=-1, keepdims=True
+                )  # (N, 1)
             class_xtr = class_sum(a_m * r_own) / n_c_safe[:, None]  # (C, d)
             r_own_mean = class_sum(r_own)[:, 0] / n_c_safe  # (C,)
 
             mean_mix = (1 - w) * res_mean + w * r_own_mean  # (C,)
             model = xs[i]
 
-            # per-pass chunked stats: everything the rhs needs
+            # per-pass chunked stats: everything the rhs needs, laid out
+            # in the block's solve-path chunk geometry
+            s_chunk, nch = (
+                wood_chunks[i] if use_woodbury[i] else (class_chunk, n_chunks)
+            )
+            cp_i = s_chunk * nch
             stats = {
-                "class_xtr": pad_classes(class_xtr, 0).reshape(
-                    n_chunks, class_chunk, -1
+                "class_xtr": pad_classes(class_xtr, 0, cp_i).reshape(
+                    nch, s_chunk, -1
                 ),
-                "joint_mean": pad_classes(joint_mean, 0).reshape(
-                    n_chunks, class_chunk, -1
+                "joint_mean": pad_classes(joint_mean, 0, cp_i).reshape(
+                    nch, s_chunk, -1
                 ),
-                "mean_mix": pad_classes(mean_mix, 0).reshape(
-                    n_chunks, class_chunk
+                "mean_mix": pad_classes(mean_mix, 0, cp_i).reshape(
+                    nch, s_chunk
                 ),
-                "pop_xtr": pad_classes(pop_xtr.T, 0).reshape(
-                    n_chunks, class_chunk, -1
+                "pop_xtr": pad_classes(pop_xtr.T, 0, cp_i).reshape(
+                    nch, s_chunk, -1
                 ),
-                "model_col": pad_classes(model.T, 0).reshape(
-                    n_chunks, class_chunk, -1
+                "model_col": pad_classes(model.T, 0, cp_i).reshape(
+                    nch, s_chunk, -1
                 ),
             }
 
@@ -552,7 +620,7 @@ def _weighted_bcd_fit(
                         x = x + wsolve(rhs - matvec(x))
                     return x  # (S, d)
 
-                deltas = jax.lax.map(solve_chunk, (wood_pre[i], stats))
+                deltas = map_chunks(solve_chunk, (wood_pre[i], stats), nch)
             else:
                 # dense per-class normal equations (big classes or the
                 # traced-label masked fallback)
@@ -567,8 +635,8 @@ def _weighted_bcd_fit(
                             )[:, 0]
                         )(pre["c"], pre["s"], pre["jxtx"], chunk_rhs(s))
 
-                    deltas = jax.lax.map(
-                        solve_chunk, (dense_pre[i], stats)
+                    deltas = map_chunks(
+                        solve_chunk, (dense_pre[i], stats), nch
                     )
                 else:
                     stats.update(class_static_stats(a_m))
@@ -586,9 +654,9 @@ def _weighted_bcd_fit(
                         )(joint_xtx, chunk_rhs(s))
                         return delta  # (S, d)
 
-                    deltas = jax.lax.map(solve_chunk, stats)  # (K, S, d)
+                    deltas = map_chunks(solve_chunk, stats, nch)  # (K, S, d)
 
-            delta = deltas.reshape(c_pad, -1)[:c].T  # (d, C)
+            delta = deltas.reshape(cp_i, -1)[:c].T  # (d, C)
             xs[i] = xs[i] + delta
             resid = resid - a_m @ delta
             res_mean = residual_mean(resid)

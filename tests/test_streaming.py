@@ -136,6 +136,41 @@ def test_featurize_stream_bounded_memory_100k():
     )
 
 
+def test_featurize_stream_prefetch_matches_sync(rng):
+    """Overlapped execution (decode-ahead thread + in-flight device
+    chunks) is a scheduling change only: outputs equal the synchronous
+    path bit for bit, ragged tail included."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.loaders.streaming import prefetch_batches
+
+    batches = [
+        rng.normal(size=(b, 8, 8, 3)).astype(np.float32)
+        for b in (64, 64, 17)
+    ]
+    fn = jax.jit(lambda b: jnp.sum(b, axis=(1, 2)))
+    sync = featurize_stream(iter(batches), fn, chunk_size=32, prefetch=0)
+    overlap = featurize_stream(
+        prefetch_batches(iter(batches), depth=2), fn, chunk_size=32
+    )
+    assert sync.shape == (145, 3)
+    np.testing.assert_array_equal(sync, overlap)
+
+
+def test_prefetch_batches_propagates_producer_error():
+    from keystone_tpu.loaders.streaming import prefetch_batches
+
+    def bad():
+        yield np.zeros((4, 2), np.float32)
+        raise RuntimeError("decode exploded")
+
+    it = prefetch_batches(bad(), depth=1)
+    next(it)
+    with pytest.raises(RuntimeError, match="decode exploded"):
+        for _ in it:
+            pass
+
+
 def test_imagenet_streaming_matches_eager_shape(mesh8):
     """Two-pass streaming ImageNet produces sane metrics on a synthetic
     in-memory source (the tar source shares the same iterator contract)."""

@@ -25,6 +25,9 @@ log "4/5 tpu_validate (incl. 32k long-context fwd + train probes)"
 TPU_VALIDATE_LONG=1 timeout 3600 python tools/tpu_validate.py \
   || log "tpu_validate FAILED ($?)"
 
+log "4b/5 stream feed probe (input- vs compute-bound, VERDICT r4 #9)"
+timeout 1800 python tools/stream_feed_probe.py || log "stream_feed FAILED ($?)"
+
 log "5/5 imagenet scale (reduced 20k warmup, then full 100k)"
 timeout 3600 python tools/imagenet_scale_run.py \
   --num-images 20000 --out IMAGENET_SCALE_20K.json \
