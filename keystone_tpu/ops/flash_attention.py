@@ -588,6 +588,14 @@ def _env_int(name: str, default: int) -> int:
 _DENSE_BWD_MAX_BYTES = 4 << 30
 
 
+def _dense_bwd_max_bytes() -> int:
+    # tunable per call like the other KST_FLASH_* knobs (0 forces the
+    # blockwise backward everywhere — the dense-vs-blockwise A/B axis of
+    # tools/lm_mfu_push.py); unset/malformed keeps the module default,
+    # which tests monkeypatch directly (read at call time)
+    return _env_int("KST_FLASH_DENSE_BWD_MAX", _DENSE_BWD_MAX_BYTES)
+
+
 def _bwd_block() -> int:
     # read per call, like the forward block_q/block_k pair — setting
     # KST_FLASH_BWD_BLOCK after import must take effect (a tuner knob)
@@ -751,7 +759,7 @@ def _flash_trainable_fwd(q, k, v, causal: bool):
             f"flash_attention_trainable: causal cross-attention with "
             f"s_q={q.shape[2]} != s_k={k.shape[2]} is ambiguous"
         )
-    if _dense_bwd_bytes(q, k) <= _DENSE_BWD_MAX_BYTES:
+    if _dense_bwd_bytes(q, k) <= _dense_bwd_max_bytes():
         # short context: the dense backward needs only (q, k, v)
         return flash_attention(q, k, v, causal=causal), (q, k, v, None, None)
     out, lse = flash_attention(q, k, v, causal=causal, return_lse=True)

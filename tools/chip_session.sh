@@ -1,40 +1,47 @@
 #!/usr/bin/env bash
 # One-shot on-chip measurement session, priority-ordered so a short
 # tunnel window still captures the round gate first:
-#   1. bench.py                  -> BENCH_TPU_LAST.json (driver-verifiable record)
-#   2. tools/mfu_sweep.py        -> MFU_SWEEP.json (roofline phase split)
-#   3. tools/flash_sweep.py      -> FLASH_SWEEP.json (long-context block tuning)
-#   4. tools/tpu_validate.py     -> TPU_VALIDATION.json (Pallas keep/retire data)
-#   5. tools/imagenet_scale_run.py (reduced then full) -> IMAGENET_SCALE.json
+#   1. bench.py                   -> BENCH_TPU_LAST.json (driver-verifiable record)
+#   2. tools/mfu_sweep.py         -> MFU_SWEEP.json (roofline phase split)
+#   3. tools/lm_mfu_push.py       -> LM_MFU_PUSH.json + LM_BENCH_TUNED.json
+#                                    (flagship train-step config sweep)
+#   4. tools/flash_sweep.py       -> FLASH_SWEEP.json (long-context block tuning)
+#   5. tools/tpu_validate.py      -> TPU_VALIDATION.json (Pallas keep/retire data)
+#   6. tools/stream_feed_probe.py -> STREAM_FEED.json (input- vs compute-bound)
+#   7. tools/imagenet_scale_run.py (reduced then full) -> IMAGENET_SCALE*.json
+#   8. bench.py again             -> picks up LM_BENCH_TUNED.json automatically
 # Run with no JAX_PLATFORMS pin (the default env reaches the chip).
 set -uo pipefail
 DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
 cd "$DIR"
 log() { echo "=== $(date -u +%FT%TZ) $*"; }
 
-log "1/5 bench.py"
+log "1/8 bench.py"
 timeout 2700 python bench.py || log "bench.py FAILED ($?)"
 
-log "2/5 mfu_sweep"
+log "2/8 mfu_sweep"
 timeout 1800 python tools/mfu_sweep.py || log "mfu_sweep FAILED ($?)"
 
-log "3/5 flash block sweep (long-context MFU lever)"
+log "3/8 lm mfu push (VERDICT r4 #2: flagship train-step config sweep)"
+timeout 2700 python tools/lm_mfu_push.py || log "lm_mfu_push FAILED ($?)"
+
+log "4/8 flash block sweep (long-context MFU lever)"
 timeout 4500 python tools/flash_sweep.py || log "flash_sweep FAILED ($?)"
 
-log "4/5 tpu_validate (incl. 32k long-context fwd + train probes)"
+log "5/8 tpu_validate (incl. 32k long-context fwd + train probes)"
 TPU_VALIDATE_LONG=1 timeout 3600 python tools/tpu_validate.py \
   || log "tpu_validate FAILED ($?)"
 
-log "4b/5 stream feed probe (input- vs compute-bound, VERDICT r4 #9)"
+log "6/8 stream feed probe (input- vs compute-bound, VERDICT r4 #9)"
 timeout 1800 python tools/stream_feed_probe.py || log "stream_feed FAILED ($?)"
 
-log "5/5 imagenet scale (reduced 20k warmup, then full 100k)"
+log "7/8 imagenet scale (reduced 20k warmup, then full 100k)"
 timeout 3600 python tools/imagenet_scale_run.py \
   --num-images 20000 --out IMAGENET_SCALE_20K.json \
   || log "imagenet 20k FAILED ($?)"
 timeout 14400 python tools/imagenet_scale_run.py \
   || log "imagenet 100k FAILED ($?)"
 
-log "refresh bench cache at session end"
+log "8/8 refresh bench at session end (applies LM_BENCH_TUNED.json if written)"
 timeout 1800 python bench.py || log "final bench FAILED ($?)"
 log "done"

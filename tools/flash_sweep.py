@@ -1,9 +1,10 @@
 """Flash-attention block-size sweep for the long-context train step.
 
 VERDICT r3 #2 names attention-backward block sizes as an MFU lever; the
-kernels' tunables are env knobs (`KST_FLASH_*`, ops/flash_attention.py)
-— the backward pair is read at import, the forward pair per call — so
-each configuration runs in a FRESH subprocess for a clean read. This
+kernels' tunables are env knobs (`KST_FLASH_*`, ops/flash_attention.py,
+all read per call) — each configuration still runs in a FRESH
+subprocess so the shape-keyed jit cache can't serve config A's
+compiled program to config B. This
 harness times one 16k-token causal train step per
 configuration (the workload whose S² term the blocks govern —
 bench.bench_lm_longctx's shape) and writes FLASH_SWEEP.json with
