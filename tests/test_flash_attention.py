@@ -161,6 +161,25 @@ def test_ring_flash_under_jit_long_sequence(mesh8, rng):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+def test_dense_bwd_env_knob_selects_path(rng, monkeypatch):
+    """KST_FLASH_DENSE_BWD_MAX=0 must force the blockwise backward (the
+    lm_mfu_push A/B axis): the fwd saves (out, lse) residuals only on
+    the blockwise path, so their presence IS the path taken."""
+    import keystone_tpu.ops.flash_attention as fa
+
+    q = jnp.asarray(rng.normal(size=(1, 2, 128, 32)).astype(np.float32))
+    monkeypatch.delenv("KST_FLASH_DENSE_BWD_MAX", raising=False)
+    _, res = fa._flash_trainable_fwd(q, q, q, False)
+    assert res[3] is None, "small shape should default to the dense bwd"
+    monkeypatch.setenv("KST_FLASH_DENSE_BWD_MAX", "0")
+    _, res = fa._flash_trainable_fwd(q, q, q, False)
+    assert res[3] is not None, "env 0 must force the blockwise bwd"
+    # malformed value falls back to the default, like the sibling knobs
+    monkeypatch.setenv("KST_FLASH_DENSE_BWD_MAX", "not-an-int")
+    _, res = fa._flash_trainable_fwd(q, q, q, False)
+    assert res[3] is None
+
+
 @pytest.mark.parametrize("causal", [False, True])
 @pytest.mark.parametrize("s", [196, 1024])
 def test_blockwise_backward_matches_dense_grads(rng, causal, s, monkeypatch):
