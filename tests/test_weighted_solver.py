@@ -153,6 +153,34 @@ def test_woodbury_sharded_matches_local(rng, mesh8):
     )
 
 
+def test_woodbury_multichunk_matches_single_chunk(rng, monkeypatch):
+    """The budget-derived Woodbury grouping (round 5) must be a pure
+    scheduling choice: forcing multiple chunks (tiny budget + small
+    class_chunk, incl. class-padding of the last chunk) reproduces the
+    default one-shot grouping's fit."""
+    import keystone_tpu.ops.weighted_linear as wl
+
+    n, d, c = 419, 160, 8  # Woodbury-active; distinct shape → own trace
+    a, y = _data(rng, n=n, d=d, c=c)
+    kw = dict(block_size=d, num_iter=3, lam=0.15, mixture_weight=0.4)
+    m_one = BlockWeightedLeastSquaresEstimator(
+        class_chunk=8, **kw
+    ).fit(jnp.asarray(a), jnp.asarray(y))
+    monkeypatch.setattr(wl, "_WOODBURY_CHUNK_BUDGET", 1)
+    # budget 1 → s_chunk falls back to class_chunk=3 → ceil(8/3)=3 chunks
+    m_multi = BlockWeightedLeastSquaresEstimator(
+        class_chunk=3, **kw
+    ).fit(jnp.asarray(a), jnp.asarray(y))
+    scale = float(np.abs(np.asarray(m_one.xs[0])).max()) or 1.0
+    np.testing.assert_allclose(
+        np.asarray(m_multi.xs[0]), np.asarray(m_one.xs[0]),
+        atol=1e-4 * scale,
+    )
+    np.testing.assert_allclose(
+        np.asarray(m_multi.b), np.asarray(m_one.b), atol=1e-4
+    )
+
+
 def test_woodbury_path_matches_exact_optimum(rng):
     """At wide blocks with small classes (class_l + 2 ≤ d_block/2) the grid
     layout switches the per-class solves to the Woodbury low-rank path —

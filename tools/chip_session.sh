@@ -44,4 +44,20 @@ timeout 14400 python tools/imagenet_scale_run.py \
 
 log "8/8 refresh bench at session end (applies LM_BENCH_TUNED.json if written)"
 timeout 1800 python bench.py || log "final bench FAILED ($?)"
+
+# persist the captures even if the session fired unattended (e.g. the
+# watcher caught a tunnel window after the build session ended).
+# Add per file (a single git add is atomic — one missing pathspec and
+# NOTHING stages) and commit with the artifact pathspec only, so
+# anything an interrupted build session left staged is untouched.
+arts=""
+for f in BENCH_TPU_LAST.json MFU_SWEEP.json LM_MFU_PUSH.json \
+  LM_BENCH_TUNED.json FLASH_SWEEP.json TPU_VALIDATION.json \
+  STREAM_FEED.json IMAGENET_SCALE_20K.json IMAGENET_SCALE.json; do
+  [ -e "$f" ] && git add -- "$f" 2>/dev/null && arts="$arts $f"
+done
+if [ -n "$arts" ] && ! git diff --cached --quiet -- $arts 2>/dev/null; then
+  git commit -m "Record on-chip measurement session artifacts" -- $arts \
+    || log "artifact commit FAILED ($?)"
+fi
 log "done"
