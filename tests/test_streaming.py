@@ -157,6 +157,33 @@ def test_featurize_stream_prefetch_matches_sync(rng):
     np.testing.assert_array_equal(sync, overlap)
 
 
+def test_prefetch_batches_releases_producer_on_abandon():
+    """Closing the consumer generator early (featurizer crash, partial
+    read) must retire the producer thread instead of leaving it parked
+    in q.put holding decoded batches."""
+    import threading
+    import time
+
+    from keystone_tpu.loaders.streaming import prefetch_batches
+
+    produced = []
+
+    def source():
+        for i in range(100):
+            produced.append(i)
+            yield np.zeros((4, 2), np.float32)
+
+    before = threading.active_count()
+    it = prefetch_batches(source(), depth=1)
+    next(it)
+    it.close()  # abandon mid-stream — finally sets the stop event
+    deadline = time.monotonic() + 5.0
+    while threading.active_count() > before and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before, "producer thread leaked"
+    assert len(produced) < 100, "producer should stop early, not drain"
+
+
 def test_prefetch_batches_propagates_producer_error():
     from keystone_tpu.loaders.streaming import prefetch_batches
 

@@ -275,6 +275,26 @@ def main() -> None:
             n_w / sec, 1
         )
 
+    # prep-vs-pass decomposition at the default precision: t(k passes) is
+    # affine in k, so per_pass = (t3 - t1)/2 and prep = t1 - per_pass —
+    # attributes the round-5 cuts (grid-identity removal, one-shot
+    # Woodbury grouping) to the phase they land in (ROOFLINE §3)
+    def _fit_iters(k):
+        e = BlockWeightedLeastSquaresEstimator(
+            block_size=d_w, num_iter=k, lam=1e-3, mixture_weight=0.5,
+            class_chunk=16,
+        )
+        return _timed(lambda: e.fit(aw, yw), iters=2)
+
+    t1, t3 = _fit_iters(1), _fit_iters(3)
+    per_pass = max((t3 - t1) / 2, 0.0)
+    out["phases"]["weighted_fit_split"] = {
+        "prep_plus_gather_s": round(max(t1 - per_pass, 0.0), 4),
+        "per_pass_s": round(per_pass, 4),
+        "t1_s": round(t1, 4),
+        "t3_s": round(t3, 4),
+    }
+
     # ---- ImageNet-shaped weighted solver (d=4096 blocks, C=1000) ----
     # the shape the Woodbury redesign targets (VERDICT r3 weak #5);
     # problem + cost model live in bench.weighted_imagenet_problem.
