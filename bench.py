@@ -23,6 +23,7 @@ scalar index + host transfer.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import datetime
 import json
@@ -468,6 +469,28 @@ def _lm_train_step_rate(
     }
 
 
+@contextlib.contextmanager
+def _env_override(updates: dict):
+    """Apply env-var ``updates`` for the duration of the block and
+    restore the prior state on exit (value ``None`` means unset the
+    var). Shared by the tuned-config benches — the None-means-pop
+    restore pattern is subtle enough to keep in ONE place."""
+    saved = {k: os.environ.get(k) for k in updates}
+    try:
+        for k, v in updates.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = str(v)
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def _lm_tuned_config() -> dict | None:
     """Winning knob set from tools/lm_mfu_push.py, if one was captured
     on chip for the current bench shape (LM_BENCH_TUNED.json). The push
@@ -506,18 +529,22 @@ def bench_lm_train() -> dict:
     kwargs["logit_chunk"] = int(tuned.get("logit_chunk", 0))
     if tuned.get("remat"):
         kwargs["remat"] = tuned["remat"]
-    env_save = os.environ.get("KST_FLASH_DENSE_BWD_MAX")
+    # knob set for the tuned run: dense_bwd EXPLICITLY both ways (so a
+    # pre-existing export can't silently mislabel the artifact) plus any
+    # per-call KST_* knobs the stage-2 push recorded (attention impl,
+    # flash block sizes — tools/lm_mfu_push2.py writes tuned["env"])
+    env_updates: dict = {
+        "KST_FLASH_DENSE_BWD_MAX": (
+            None if tuned.get("dense_bwd", True) else "0"
+        )
+    }
+    env_updates.update(tuned.get("env") or {})
     try:
-        # set the knob EXPLICITLY both ways so a pre-existing export
-        # can't silently mislabel the tuned artifact
-        if tuned.get("dense_bwd", True):
-            os.environ.pop("KST_FLASH_DENSE_BWD_MAX", None)
-        else:
-            os.environ["KST_FLASH_DENSE_BWD_MAX"] = "0"
-        res = _lm_train_step_rate(**kwargs)
+        with _env_override(env_updates):
+            res = _lm_train_step_rate(**kwargs)
         res["tuned_config"] = {
             k: tuned[k]
-            for k in ("batch", "logit_chunk", "dense_bwd", "remat")
+            for k in ("batch", "logit_chunk", "dense_bwd", "remat", "env")
             if k in tuned
         }
         return res
@@ -527,30 +554,58 @@ def bench_lm_train() -> dict:
             "falling back to the default config",
             file=sys.stderr,
         )
-        os.environ.pop("KST_FLASH_DENSE_BWD_MAX", None)
+        # the context manager already restored on unwind: the default
+        # rerun sees a clean env
         return _lm_train_step_rate(**default_kwargs)
-    finally:
-        if env_save is None:
-            os.environ.pop("KST_FLASH_DENSE_BWD_MAX", None)
-        else:
-            os.environ["KST_FLASH_DENSE_BWD_MAX"] = env_save
 
 
 LM_LONG_SEQ, LM_LONG_DIM, LM_LONG_DEPTH = 16_384, 512, 4
+
+
+def _flash_tuned_env(path: str | None = None) -> dict:
+    """Winning block sizes from the on-chip flash sweep
+    (FLASH_SWEEP.json, tools/flash_sweep.py), as KST_FLASH_* env knobs
+    for the long-context bench. The sweep tags configs
+    ``q{bq}_k{bk}_bwd{bwd}_c{chunks}``; a malformed or missing artifact
+    means no override (kernel defaults)."""
+    if path is None:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "FLASH_SWEEP.json")
+    try:
+        with open(path) as f:
+            best = json.load(f)["best"]["config"]
+        bq, bk, bwd, chunks = (
+            part.lstrip("qkbwdc") for part in best.split("_")
+        )
+        return {
+            "KST_FLASH_BLOCK_Q": str(int(bq)),
+            "KST_FLASH_BLOCK_K": str(int(bk)),
+            "KST_FLASH_BWD_BLOCK": str(int(bwd)),
+            "KST_FLASH_BWD_CHUNKS": str(int(chunks)),
+        }
+    except (OSError, ValueError, KeyError, TypeError):
+        return {}
 
 
 def bench_lm_longctx() -> dict:
     """One long-context causal train step (S=16k, rope positions): the
     attention S² term dominates and the FlashAttention-style blockwise
     backward carries the step — the dense-recompute backward's transient
-    (S, S) tensors would not fit. TPU-only like bench_lm_train."""
-    res = _lm_train_step_rate(
-        seq=LM_LONG_SEQ, dim=LM_LONG_DIM, depth=LM_LONG_DEPTH, heads=8,
-        batch=1, pos_encoding="rope", use_mesh=False, iters=2,
-        # never materialize the (S, 32k-vocab) f32 logits (2.1 GB + its
-        # grad at S=16k): the CE runs in 4k-position chunks
-        logit_chunk=4096,
-    )
+    (S, S) tensors would not fit. TPU-only like bench_lm_train. Applies
+    the on-chip flash-sweep winner's block sizes (FLASH_SWEEP.json) when
+    one exists, recorded in the result."""
+    tuned = _flash_tuned_env()
+    with _env_override(tuned):
+        res = _lm_train_step_rate(
+            seq=LM_LONG_SEQ, dim=LM_LONG_DIM, depth=LM_LONG_DEPTH,
+            heads=8, batch=1, pos_encoding="rope", use_mesh=False,
+            iters=2,
+            # never materialize the (S, 32k-vocab) f32 logits (2.1 GB +
+            # its grad at S=16k): the CE runs in 4k-position chunks
+            logit_chunk=4096,
+        )
+    if tuned:
+        res["flash_tuned_env"] = tuned
     res.pop("params", None)
     return res
 

@@ -255,7 +255,20 @@ class TransformerLM:
         else:
             from keystone_tpu.ops.flash_attention import on_tpu
 
-            if on_tpu():
+            # KST_LOCAL_ATTN overrides the auto-select (read per call,
+            # like the KST_FLASH_* knobs): the S=2048 flagship shape sits
+            # in the regime where dense XLA attention can rival the
+            # Pallas kernel (TPU_VALIDATION 0.98-1.27x at <=8k), so the
+            # MFU push sweeps this axis too (tools/lm_mfu_push2.py)
+            import os as _os
+
+            mode = _os.environ.get("KST_LOCAL_ATTN", "auto")
+            if mode not in ("auto", "flash", "dense"):
+                raise ValueError(
+                    f"KST_LOCAL_ATTN={mode!r}; expected auto|flash|dense"
+                )
+            use_flash = on_tpu() if mode == "auto" else mode == "flash"
+            if use_flash:
                 # fused Pallas forward with a recompute VJP — training
                 # never materializes the (S, S) probabilities
                 from keystone_tpu.ops.flash_attention import (

@@ -221,3 +221,93 @@ def test_success_persists_tpu_record(monkeypatch, tmp_path, capsys):
     assert saved["timestamp"]
     line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert "CPU FALLBACK" not in line["metric"]
+
+
+def test_lm_tuned_env_knobs_applied_and_restored(monkeypatch):
+    """bench_lm_train must apply the tuned artifact's env knobs (incl.
+    the stage-2 push's ``env`` dict) for the tuned run only: set during
+    the measured call, restored after — and restored BEFORE the default
+    fallback rerun when the tuned config fails."""
+    import os
+
+    bench = _load_bench()
+    tuned = {
+        "shape": f"dim{bench.LM_DIM}_depth{bench.LM_DEPTH}_s{bench.LM_SEQ}",
+        "batch": 32,
+        "logit_chunk": 0,
+        "dense_bwd": False,
+        "remat": False,
+        "env": {"KST_LOCAL_ATTN": "dense", "KST_FLASH_BLOCK_Q": "256"},
+    }
+    monkeypatch.setattr(bench, "_lm_tuned_config", lambda: tuned)
+    monkeypatch.delenv("KST_LOCAL_ATTN", raising=False)
+    monkeypatch.delenv("KST_FLASH_BLOCK_Q", raising=False)
+    monkeypatch.setenv("KST_FLASH_DENSE_BWD_MAX", "12345")  # pre-existing
+
+    seen = []
+
+    def fake_rate(**kw):
+        seen.append(
+            {
+                "batch": kw["batch"],
+                "attn": os.environ.get("KST_LOCAL_ATTN"),
+                "bq": os.environ.get("KST_FLASH_BLOCK_Q"),
+                "dense_max": os.environ.get("KST_FLASH_DENSE_BWD_MAX"),
+            }
+        )
+        return {"tokens_per_s": 1.0, "tflops_per_s": 1.0}
+
+    monkeypatch.setattr(bench, "_lm_train_step_rate", fake_rate)
+    res = bench.bench_lm_train()
+    assert seen == [
+        {"batch": 32, "attn": "dense", "bq": "256", "dense_max": "0"}
+    ]
+    assert res["tuned_config"]["env"] == tuned["env"]
+    # restored: the knobs are gone, the pre-existing export is back
+    assert "KST_LOCAL_ATTN" not in os.environ
+    assert "KST_FLASH_BLOCK_Q" not in os.environ
+    assert os.environ["KST_FLASH_DENSE_BWD_MAX"] == "12345"
+
+    # failing tuned config: the default rerun must see a CLEAN env
+    seen.clear()
+    calls = {"n": 0}
+
+    def fail_then_ok(**kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            fake_rate(**kw)
+            raise RuntimeError("OOM")
+        return fake_rate(**kw)
+
+    monkeypatch.setattr(bench, "_lm_train_step_rate", fail_then_ok)
+    res = bench.bench_lm_train()
+    assert "tuned_config" not in res
+    assert seen[0]["attn"] == "dense"
+    assert seen[1] == {
+        "batch": bench.LM_BATCH,
+        "attn": None,
+        "bq": None,
+        "dense_max": "12345",
+    }
+
+
+def test_flash_tuned_env_parses_sweep_winner(tmp_path):
+    """bench_lm_longctx's block override must round-trip the flash
+    sweep's config tag — and degrade to no override on a malformed or
+    absent artifact."""
+    bench = _load_bench()
+    art = tmp_path / "FLASH_SWEEP.json"
+    art.write_text(
+        json.dumps({"best": {"config": "q256_k512_bwd1024_c16"}})
+    )
+    assert bench._flash_tuned_env(str(art)) == {
+        "KST_FLASH_BLOCK_Q": "256",
+        "KST_FLASH_BLOCK_K": "512",
+        "KST_FLASH_BWD_BLOCK": "1024",
+        "KST_FLASH_BWD_CHUNKS": "16",
+    }
+    art.write_text(json.dumps({"best": None}))  # all-configs-failed sweep
+    assert bench._flash_tuned_env(str(art)) == {}
+    art.write_text("not json")
+    assert bench._flash_tuned_env(str(art)) == {}
+    assert bench._flash_tuned_env(str(tmp_path / "missing.json")) == {}

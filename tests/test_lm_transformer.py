@@ -704,3 +704,45 @@ def test_gqa_composes_with_ring_sp_training(mesh8):
         losses.append(float(loss))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0], losses
+
+
+def test_local_attn_env_knob_selects_path(monkeypatch):
+    """KST_LOCAL_ATTN must override the local-mode auto-select (the
+    stage-2 MFU push A/B axis, tools/lm_mfu_push2.py): 'flash' forces
+    the Pallas trainable wrapper even off-TPU, 'dense' forces the XLA
+    path, and an unknown value fails loudly like the sibling knobs."""
+    import keystone_tpu.ops.flash_attention as fa
+
+    model = _tiny()
+    toks = jnp.asarray(
+        np.random.default_rng(0).integers(0, 31, size=(1, 16))
+    )
+    calls = []
+    real = fa.flash_attention_trainable
+
+    def spy(q, k, v, causal):
+        calls.append("flash")
+        return real(q, k, v, causal)
+
+    monkeypatch.setattr(fa, "flash_attention_trainable", spy)
+
+    monkeypatch.delenv("KST_LOCAL_ATTN", raising=False)
+    model(toks)
+    assert not calls, "auto off-TPU must take the dense path"
+
+    monkeypatch.setenv("KST_LOCAL_ATTN", "flash")
+    out_flash = model(toks)
+    assert calls == ["flash"] * len(model.blocks)
+
+    calls.clear()
+    monkeypatch.setenv("KST_LOCAL_ATTN", "dense")
+    out_dense = model(toks)
+    assert not calls
+    # both paths compute the same attention
+    np.testing.assert_allclose(
+        np.asarray(out_flash), np.asarray(out_dense), atol=2e-4
+    )
+
+    monkeypatch.setenv("KST_LOCAL_ATTN", "fused")
+    with pytest.raises(ValueError, match="KST_LOCAL_ATTN"):
+        model(toks)

@@ -24,6 +24,10 @@ timeout 1800 python tools/mfu_sweep.py || log "mfu_sweep FAILED ($?)"
 
 log "3/8 lm mfu push (VERDICT r4 #2: flagship train-step config sweep)"
 timeout 2700 python tools/lm_mfu_push.py || log "lm_mfu_push FAILED ($?)"
+# stage 2 crosses the stage-1 winner with the attention-impl axis and
+# big-batch + chunked-CE retries; runs AFTER stage 1 so a stage-2 win
+# (richer env knobs) is the last writer of LM_BENCH_TUNED.json
+timeout 2700 python tools/lm_mfu_push2.py || log "lm_mfu_push2 FAILED ($?)"
 
 log "4/8 flash block sweep (long-context MFU lever)"
 timeout 4500 python tools/flash_sweep.py || log "flash_sweep FAILED ($?)"
@@ -52,8 +56,9 @@ timeout 1800 python bench.py || log "final bench FAILED ($?)"
 # anything an interrupted build session left staged is untouched.
 arts=""
 for f in BENCH_TPU_LAST.json MFU_SWEEP.json LM_MFU_PUSH.json \
-  LM_BENCH_TUNED.json FLASH_SWEEP.json TPU_VALIDATION.json \
-  STREAM_FEED.json IMAGENET_SCALE_20K.json IMAGENET_SCALE.json; do
+  LM_MFU_PUSH2.json LM_BENCH_TUNED.json FLASH_SWEEP.json \
+  TPU_VALIDATION.json STREAM_FEED.json IMAGENET_SCALE_20K.json \
+  IMAGENET_SCALE.json; do
   [ -e "$f" ] && git add -- "$f" 2>/dev/null && arts="$arts $f"
 done
 if [ -n "$arts" ] && ! git diff --cached --quiet -- $arts 2>/dev/null; then

@@ -31,16 +31,18 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # forces the blockwise flash backward (KST_FLASH_DENSE_BWD_MAX=0):
 # at S=2048 the dense path's transient (S,S) f32 tensors are ~2.1 GB of
 # HBM traffic per (B,H) slice class — whether recompute beats that
-# traffic is exactly what the chip must answer.
+# traffic is exactly what the chip must answer. logit_chunk must divide
+# the S=2048 trained positions (the r5 session failed 8192 on exactly
+# that check — fixed to 1024).
 CONFIGS = [
     (8, True, 0, False),
     (8, False, 0, False),
-    (8, True, 8192, False),
+    (8, True, 1024, False),
     (16, True, 0, False),
     (16, False, 0, False),
     (32, True, 0, False),
     (32, False, 0, False),
-    (32, True, 8192, False),
+    (32, True, 1024, False),
     (32, True, 0, "dots"),  # memory headroom fallback for the big batch
 ]
 

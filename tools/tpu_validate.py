@@ -577,22 +577,34 @@ def main() -> int:
         "baselines have been observed to move ~3x between sessions); "
         "compare speedups only within one artifact, never across rounds",
     }
-    validate_flash_attention(results)
-    validate_flash_step(results)
-    validate_conv_convolver(results)
-    validate_weighted_solver_scale(results)
-    if os.environ.get("TPU_VALIDATE_LONG"):
-        validate_long_context(results)
-        validate_long_decode(results)
     out = REPO / "TPU_VALIDATION.json"
-    # merge-update: opt-in sections (e.g. the 32k long-context record)
-    # must survive runs that don't re-validate them
-    try:
-        prior = json.loads(out.read_text())
-    except Exception:  # noqa: BLE001 — first run / corrupt file
-        prior = {}
-    results = {**prior, **results}
-    out.write_text(json.dumps(results, indent=2) + "\n")
+
+    def _flush() -> dict:
+        # merge-update: opt-in sections (e.g. the 32k long-context
+        # record) must survive runs that don't re-validate them. Written
+        # after EVERY probe — the r5 session lost a full 60-minute
+        # tpu_validate to one wedged long-context probe because the
+        # artifact only flushed at exit; completed probes now persist.
+        try:
+            prior = json.loads(out.read_text())
+        except Exception:  # noqa: BLE001 — first run / corrupt file
+            prior = {}
+        merged = {**prior, **results}
+        out.write_text(json.dumps(merged, indent=2) + "\n")
+        return merged
+
+    probes = [
+        validate_flash_attention,
+        validate_flash_step,
+        validate_conv_convolver,
+        validate_weighted_solver_scale,
+    ]
+    if os.environ.get("TPU_VALIDATE_LONG"):
+        probes += [validate_long_context, validate_long_decode]
+    for probe in probes:
+        probe(results)
+        merged = _flush()
+    results = merged
     print(json.dumps(results, indent=2))
     print(f"\nall compiled-kernel validations passed -> {out}")
     return 0
