@@ -1,0 +1,61 @@
+#!/usr/bin/env bash
+# Round-5 resume roster: the chip work the wedged tunnel interrupted,
+# priority-ordered (stage-2 MFU push first — the only item that can
+# still move the headline number). Safe to run unattended: pauses any
+# in-flight CPU ImageNet run (SIGSTOP via .imagenet_pid) so the single
+# host core serves the chip session's dispatch/compile, and resumes it
+# after. Skips nothing that chip_session.sh already captured — phases
+# 1-4 landed at HEAD on 2026-08-01; this covers 5-8 plus stage 2.
+set -uo pipefail
+DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+cd "$DIR"
+log() { echo "=== $(date -u +%FT%TZ) $*"; }
+
+IMG_PID=""
+if [ -f .imagenet_pid ]; then
+  IMG_PID="$(awk '{print $2}' .imagenet_pid)"
+  if [ -n "$IMG_PID" ] && kill -0 "$IMG_PID" 2>/dev/null; then
+    log "pausing CPU imagenet run (pid $IMG_PID) for the chip window"
+    pkill -STOP -P "$IMG_PID" 2>/dev/null
+    kill -STOP "$IMG_PID" 2>/dev/null
+  else
+    IMG_PID=""
+  fi
+fi
+resume_img() {
+  if [ -n "$IMG_PID" ]; then
+    log "resuming CPU imagenet run (pid $IMG_PID)"
+    kill -CONT "$IMG_PID" 2>/dev/null
+    pkill -CONT -P "$IMG_PID" 2>/dev/null
+  fi
+}
+trap resume_img EXIT
+
+log "1/5 lm mfu push stage 2 (attention impl x big-batch chunked-CE)"
+timeout 2700 python tools/lm_mfu_push2.py || log "lm_mfu_push2 FAILED ($?)"
+
+log "2/5 tpu_validate (incremental flush; LONG probes last)"
+TPU_VALIDATE_LONG=1 timeout 3600 python tools/tpu_validate.py \
+  || log "tpu_validate FAILED ($?)"
+
+log "3/5 stream feed probe"
+timeout 1800 python tools/stream_feed_probe.py || log "stream_feed FAILED ($?)"
+
+log "4/5 final bench (applies LM_BENCH_TUNED + FLASH_SWEEP winners)"
+timeout 2700 python bench.py || log "bench FAILED ($?)"
+
+log "5/5 on-chip imagenet 20k (the CPU 100k calibrated run covers scale)"
+timeout 3600 python tools/imagenet_scale_run.py \
+  --num-images 20000 --out IMAGENET_SCALE_20K.json \
+  || log "imagenet 20k FAILED ($?)"
+
+arts=""
+for f in LM_MFU_PUSH2.json LM_BENCH_TUNED.json TPU_VALIDATION.json \
+  STREAM_FEED.json BENCH_TPU_LAST.json IMAGENET_SCALE_20K.json; do
+  [ -e "$f" ] && git add -- "$f" 2>/dev/null && arts="$arts $f"
+done
+if [ -n "$arts" ] && ! git diff --cached --quiet -- $arts 2>/dev/null; then
+  git commit -m "Record resumed on-chip measurement artifacts" -- $arts \
+    || log "artifact commit FAILED ($?)"
+fi
+log "done"
