@@ -1,0 +1,69 @@
+"""CPU smoke for tools/tpu_validate.py's in-program flash-vs-dense A/B
+(validate_flash_inprogram): the chaining/equivalence/record logic runs
+off-chip with the Pallas kernel stubbed to the dense path (interpret-
+mode flash under a scan is minutes-slow on CPU; kernel correctness is
+tests/test_flash_attention.py's job). INPROG_SHAPES/_INPROG_INTERPRET
+exist exactly for this test."""
+
+import importlib.util
+import pathlib
+
+import pytest
+
+
+def _load_tv():
+    path = pathlib.Path(__file__).parent.parent / "tools" / "tpu_validate.py"
+    spec = importlib.util.spec_from_file_location("tv_under_test", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_inprogram_probe_records_both_paths(monkeypatch):
+    import keystone_tpu.ops.flash_attention as fa
+    from keystone_tpu.ops.attention import dense_attention
+
+    # offset makes max_abs_diff nonzero (proves the diff is measured)
+    # while staying inside the loose chained-divergence gate
+    monkeypatch.setattr(
+        fa,
+        "flash_attention",
+        lambda q, k, v, *, causal=False, interpret=None: (
+            dense_attention(q, k, v, causal=causal) + 1e-4
+        ),
+    )
+    tv = _load_tv()
+    tv.INPROG_SHAPES = [(1, 2, 256, 32, 3)]
+    results = {}
+    tv.validate_flash_inprogram(results)
+    rec = results["flash_inprog_256_causal"]
+    assert rec["reps_in_program"] == 3
+    assert 0 < rec["max_abs_diff"] < 0.1
+    assert rec["dense_ms_per_iter"] > 0 and rec["flash_ms_per_iter"] > 0
+    assert rec["flash_vs_dense"] == pytest.approx(
+        rec["dense_ms_per_iter"] / rec["flash_ms_per_iter"], rel=0.01
+    )
+
+
+def test_inprogram_probe_collects_divergence_across_shapes(monkeypatch):
+    """A diverging shape must still record its measurement (and every
+    other shape's) before the probe raises — the r5 session lost a
+    60-minute tpu_validate to an assert-before-flush."""
+    import keystone_tpu.ops.flash_attention as fa
+    from keystone_tpu.ops.attention import dense_attention
+
+    monkeypatch.setattr(
+        fa,
+        "flash_attention",
+        lambda q, k, v, *, causal=False, interpret=None: (
+            dense_attention(q, k, v, causal=causal) + 1.0  # diverges
+        ),
+    )
+    tv = _load_tv()
+    tv.INPROG_SHAPES = [(1, 2, 128, 32, 2), (1, 2, 256, 32, 2)]
+    results = {}
+    with pytest.raises(AssertionError, match="diverge"):
+        tv.validate_flash_inprogram(results)
+    # BOTH shapes recorded despite the failure
+    assert "flash_inprog_128_causal" in results
+    assert "flash_inprog_256_causal" in results

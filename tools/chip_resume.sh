@@ -14,7 +14,10 @@ log() { echo "=== $(date -u +%FT%TZ) $*"; }
 IMG_PID=""
 if [ -f .imagenet_pid ]; then
   IMG_PID="$(awk '{print $2}' .imagenet_pid)"
-  if [ -n "$IMG_PID" ] && kill -0 "$IMG_PID" 2>/dev/null; then
+  # identity check, not just liveness: a recycled PID must not get
+  # SIGSTOPped for hours (the pidfile can outlive the run)
+  if [ -n "$IMG_PID" ] \
+     && grep -q "imagenet_scale_run" "/proc/$IMG_PID/cmdline" 2>/dev/null; then
     log "pausing CPU imagenet run (pid $IMG_PID) for the chip window"
     pkill -STOP -P "$IMG_PID" 2>/dev/null
     kill -STOP "$IMG_PID" 2>/dev/null
