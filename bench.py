@@ -121,9 +121,8 @@ SIFT_N = 16
 SIFT_HW = 256
 SIFT_NATIVE_SUBSET = 2
 
-# bf16 peak of one v5e chip; the f32 MXU rate is lower (bf16-pass
-# emulation), so f32 workloads report conservative MFU on this basis
-PEAK_FLOPS = {"v5 lite": 197e12, "v5p": 459e12, "v4": 275e12}
+# bf16 peaks live in ONE place now: keystone_tpu.observe.report
+# (PEAK_FLOPS / peak_flops_for) — see _device_peak below
 
 
 def _synthetic(n: int) -> tuple[np.ndarray, np.ndarray]:
@@ -171,6 +170,39 @@ def dispatch_floor_ms() -> float:
     return _timed(lambda: f(x), iters=8) * 1e3
 
 
+def _mnist_per_node_breakdown(fitted, x) -> dict:
+    """Per-node wall time + compiler cost profile of the fitted MNIST
+    apply pipeline, via the observe subsystem: one instrumented eager
+    apply on a bounded probe batch, events collected in-memory (or into
+    the ambient KEYSTONE_OBSERVE_DIR run when one is active) — the
+    KeystoneML-style operator breakdown the flat samples/s number can't
+    show. ``fitted`` is the pipeline the timed fit loop already built —
+    no re-fit here."""
+    from keystone_tpu.core.pipeline import Pipeline
+    from keystone_tpu.observe import events
+    from keystone_tpu.observe.cost import record_pipeline_profile
+    from keystone_tpu.observe.report import per_node_breakdown
+    from keystone_tpu.ops.util import MaxClassifier
+
+    pipe = Pipeline.of(*fitted.nodes, MaxClassifier())
+    probe = x[:2048]
+
+    def collect(log):
+        # only the records this probe appends: the ambient log already
+        # holds the timed fit-loop's events, which are not apply rows
+        start = len(log.records)
+        profiles = record_pipeline_profile(pipe, probe, save_dir=log.run_dir)
+        return per_node_breakdown(log, profiles, since=start)
+
+    ambient = events.active()
+    if ambient is not None:
+        # an env-activated run is in flight: keep everything (node
+        # events, cost profiles, the final bench record) in ONE run dir
+        return collect(ambient)
+    with events.run(workload="mnist_random_fft") as log:  # memory-only
+        return collect(log)
+
+
 def bench_mnist(labels: np.ndarray, data: np.ndarray) -> dict:
     import jax
 
@@ -195,11 +227,20 @@ def bench_mnist(labels: np.ndarray, data: np.ndarray) -> dict:
     # single device launch instead of one per stage. Return the fitted
     # MODEL node ([-1]) — the pipeline's first leaves are the prefix
     # bank's constants, and _sync on one of those would return before the
-    # fit program has executed
+    # fit program has executed. The box keeps the last fitted pipeline so
+    # the per-node breakdown below doesn't pay a sixth fit.
+    fitted_box = {}
+
     def step():
-        return chained.fit_fused(x, y, n_valid=n)[-1]
+        fitted_box["pipe"] = chained.fit_fused(x, y, n_valid=n)
+        return fitted_box["pipe"][-1]
 
     sec = _timed(step)
+    try:
+        per_node = _mnist_per_node_breakdown(fitted_box["pipe"], x)
+    except Exception as e:  # noqa: BLE001 — observability must not cost
+        # the bench its headline number
+        per_node = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
     d = NUM_FFTS * 512  # total feature width
     # solver-phase FLOPs: Gram N*d^2 + AtB N*d*10, Cholesky d^3/3 + refine
     flops = 2 * n * d * d + 2 * n * d * 10 + d**3 / 3
@@ -219,6 +260,7 @@ def bench_mnist(labels: np.ndarray, data: np.ndarray) -> dict:
         / sec
         / 1e12
         / len(jax.devices()),
+        "per_node": per_node,
     }
 
 
@@ -808,11 +850,9 @@ def _accelerator_alive(timeout_s: float = 120.0, attempts: int = 3) -> bool:
 def _device_peak() -> float | None:
     import jax
 
-    kind = jax.devices()[0].device_kind.lower()
-    for key, peak in PEAK_FLOPS.items():
-        if key in kind:
-            return peak
-    return None
+    from keystone_tpu.observe.report import peak_flops_for
+
+    return peak_flops_for(jax.devices()[0].device_kind)
 
 
 def main() -> None:
@@ -958,6 +998,9 @@ def main() -> None:
         "baseline": "numpy/BLAS single-host CPU, same workloads "
         "(reference publishes no numbers; see BASELINE.md)",
     }
+    # per-node operator breakdown (observe subsystem): wall time per
+    # pipeline node plus compiler-modeled FLOPs/bytes when available
+    result["mnist_per_node"] = mnist.get("per_node", {})
     if "vs_native_host" in sift:
         result["sift_vs_native_host"] = round(sift["vs_native_host"], 2)
     if workload_errors:
@@ -1022,6 +1065,17 @@ def main() -> None:
             # (read-only checkout, full disk) must not discard the
             # completed run: the driver line still prints
             print(f"# bench cache write failed: {e!r}", file=sys.stderr)
+    try:
+        # route the bench record through the structured event log too,
+        # so a KEYSTONE_OBSERVE_DIR run dir carries the full artifact —
+        # but never let observability discard a completed bench run
+        from keystone_tpu.observe import events as observe_events
+
+        log = observe_events.active()
+        if log is not None:
+            log.emit("bench", result=result)
+    except Exception as e:  # noqa: BLE001
+        print(f"# bench event-log emit failed: {e!r}", file=sys.stderr)
     print(json.dumps(result))
 
 

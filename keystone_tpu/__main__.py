@@ -51,6 +51,11 @@ PIPELINES = {
     "lm-transformer": ("keystone_tpu.models.lm_transformer", None),
 }
 
+# non-pipeline subcommands: short name → module whose ``main(argv)`` runs
+COMMANDS = {
+    "observe": "keystone_tpu.observe.report",
+}
+
 
 def main(argv: list[str] | None = None) -> None:
     # honor a JAX_PLATFORMS env pin — without this, `JAX_PLATFORMS=cpu
@@ -70,17 +75,31 @@ def main(argv: list[str] | None = None) -> None:
             raise SystemExit("--profile needs a trace directory argument")
         profile_dir = argv[i + 1]
         del argv[i : i + 2]
+    observe_dir = None
+    if "--observe" in argv:
+        i = argv.index("--observe")
+        if i + 1 >= len(argv):
+            raise SystemExit("--observe needs an output directory argument")
+        observe_dir = argv[i + 1]
+        del argv[i : i + 2]
     if not argv or argv[0] in ("-h", "--help"):
         names = "\n  ".join(sorted(PIPELINES))
+        commands = "\n  ".join(sorted(COMMANDS))
         raise SystemExit(
             f"usage: python -m keystone_tpu [--multihost] "
-            f"[--profile DIR] <pipeline> [args...]\n"
+            f"[--profile DIR] [--observe DIR] <pipeline> [args...]\n"
             f"pipelines:\n  {names}\n"
+            f"commands:\n  {commands}\n"
             f"(reference class names like pipelines.images.mnist.MnistRandomFFT"
             f" are also accepted; --multihost joins this process into the\n"
             f" jax.distributed runtime before dispatch — run the same command"
-            f" on every host)"
+            f" on every host; --observe DIR writes a structured per-node\n"
+            f" event log there, rendered by `observe <dir>`)"
         )
+    if argv[0] in COMMANDS:
+        import importlib
+
+        return importlib.import_module(COMMANDS[argv[0]]).main(argv[1:])
     from keystone_tpu.core.runtime import enable_compilation_cache
 
     enable_compilation_cache()
@@ -102,13 +121,28 @@ def main(argv: list[str] | None = None) -> None:
     import importlib
 
     entry = importlib.import_module(target).main
-    if profile_dir is not None:
-        from keystone_tpu.core.profiling import trace
 
-        with trace(profile_dir):
-            entry(rest)
+    def dispatch():
+        if profile_dir is not None:
+            from keystone_tpu.core.profiling import trace
+
+            with trace(profile_dir):
+                return entry(rest)
+        return entry(rest)
+
+    if observe_dir is None:
+        import os
+
+        observe_dir = os.environ.get("KEYSTONE_OBSERVE_DIR") or None
+    if observe_dir is not None:
+        # scoped run: the launcher brackets the whole pipeline with
+        # run_start/run_end so the report knows total wall and status
+        from keystone_tpu.observe import events
+
+        with events.run(observe_dir, pipeline=name, argv=rest):
+            dispatch()
     else:
-        entry(rest)
+        dispatch()
 
 
 if __name__ == "__main__":

@@ -27,6 +27,8 @@ callers can apply it unconditionally.
 from __future__ import annotations
 
 from keystone_tpu.core.pipeline import Pipeline, Transformer
+from keystone_tpu.observe import events as _events
+from keystone_tpu.observe import metrics as _metrics
 
 
 def _try_fuse_conv_chain(a, b, c):
@@ -80,7 +82,7 @@ def optimize(pipe: Transformer) -> Transformer:
     nodes = list(pipe.nodes)
     out: list[Transformer] = []
     i = 0
-    changed = False
+    rewrites = 0
     while i < len(nodes):
         fused = (
             _try_fuse_conv_chain(nodes[i], nodes[i + 1], nodes[i + 2])
@@ -90,8 +92,25 @@ def optimize(pipe: Transformer) -> Transformer:
         if fused is not None:
             out.append(fused)
             i += 3
-            changed = True
+            rewrites += 1
         else:
             out.append(nodes[i])
             i += 1
-    return Pipeline(nodes=tuple(out)) if changed else pipe
+    if not rewrites:
+        return pipe
+    # optimizer decisions are observable: count rewrites in the metrics
+    # registry and record the plan change in the event log so a cost
+    # model (or a human) can see WHAT the pass did to a given run
+    _metrics.get_registry().counter(
+        "fusion_rewrites", rule="conv_rectify_pool"
+    ).inc(rewrites)
+    log = _events.active()
+    if log is not None:
+        log.emit(
+            "optimize",
+            rule="conv_rectify_pool",
+            rewrites=rewrites,
+            nodes_before=len(nodes),
+            nodes_after=len(out),
+        )
+    return Pipeline(nodes=tuple(out))

@@ -2,41 +2,82 @@
 
 The reference's log4j config (``src/main/resources/log4j.properties``) sets
 root=ERROR with INFO for pipeline/node/util loggers; we mirror that: the
-``keystone_tpu`` logger hierarchy defaults to INFO, everything else is left
-to the application. The Scala trait's ``@transient`` logger trick (so
-closures serialize) has no analog — pytree nodes never capture loggers.
+``keystone_tpu`` logger hierarchy defaults to INFO (override with the
+``KEYSTONE_LOG_LEVEL`` env var — a level name or number), everything else
+is left to the application. The Scala trait's ``@transient`` logger trick
+(so closures serialize) has no analog — pytree nodes never capture loggers.
 """
 
 from __future__ import annotations
 
 import logging
+import os
 import sys
+import threading
 import time
 from contextlib import contextmanager
 
 _CONFIGURED = False
+_CONFIGURE_LOCK = threading.Lock()
+
+
+def _resolve_level(value: str | None) -> int:
+    if not value:
+        return logging.INFO
+    if value.isdigit():
+        return int(value)
+    return getattr(logging, value.upper(), logging.INFO)
 
 
 def get_logger(name: str = "keystone_tpu") -> logging.Logger:
     global _CONFIGURED
     if not _CONFIGURED:
-        handler = logging.StreamHandler(sys.stderr)
-        handler.setFormatter(
-            logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
-        )
-        root = logging.getLogger("keystone_tpu")
-        root.addHandler(handler)
-        root.setLevel(logging.INFO)
-        root.propagate = False
-        _CONFIGURED = True
+        # double-checked lock: concurrent first calls (streaming loader
+        # threads, multihost workers) must not each attach a handler —
+        # duplicated handlers mean every line printed twice forever
+        with _CONFIGURE_LOCK:
+            if not _CONFIGURED:
+                handler = logging.StreamHandler(sys.stderr)
+                handler.setFormatter(
+                    logging.Formatter(
+                        "%(asctime)s %(levelname)s %(name)s: %(message)s"
+                    )
+                )
+                root = logging.getLogger("keystone_tpu")
+                root.addHandler(handler)
+                root.setLevel(
+                    _resolve_level(os.environ.get("KEYSTONE_LOG_LEVEL"))
+                )
+                root.propagate = False
+                _CONFIGURED = True
     return logging.getLogger(name)
 
 
 @contextmanager
 def log_time(label: str, logger: logging.Logger | None = None):
     """Wall-clock bracket, the reference's ``System.nanoTime`` idiom
-    (``MnistRandomFFT.scala:34,86-87``)."""
+    (``MnistRandomFFT.scala:34,86-87``).
+
+    The duration line is emitted even when the block raises (tagged
+    FAILED, at WARNING), and the bracket is mirrored as a ``span`` event
+    when a structured event log is active (observe.events).
+    """
     logger = logger or get_logger()
     t0 = time.perf_counter()
-    yield
-    logger.info("%s took %.3fs", label, time.perf_counter() - t0)
+    status = "ok"
+    try:
+        yield
+    except BaseException:
+        status = "failed"
+        raise
+    finally:
+        dt = time.perf_counter() - t0
+        if status == "ok":
+            logger.info("%s took %.3fs", label, dt)
+        else:
+            logger.warning("%s FAILED after %.3fs", label, dt)
+        from keystone_tpu.observe import events as _events
+
+        log = _events.active()
+        if log is not None:
+            log.emit("span", label=label, wall_s=dt, status=status)

@@ -30,12 +30,41 @@ Spark's lazy RDD DAG used to be.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import Any, Callable
 
 import jax
 
 from keystone_tpu.core.treenode import static_field, treenode
+from keystone_tpu.observe import events as _events
+
+_NULL_SPAN = contextlib.nullcontext()
+
+
+def _node_span(name: str, phase: str):
+    """Per-node observation bracket: a shared nullcontext when no event
+    sink is active (one global read — the hooks below must stay near-zero
+    overhead when observability is off), else an event-emitting timer."""
+    log = _events.active()
+    if log is None:
+        return _NULL_SPAN
+    return log.node(name, phase)
+
+
+def is_tracing(batch) -> bool:
+    """True when ``batch`` holds jit tracers — the single home of this
+    check (observe.instrument uses it too)."""
+    return any(
+        isinstance(leaf, jax.core.Tracer)
+        for leaf in jax.tree_util.tree_leaves(batch)
+    )
+
+
+def _call_phase(batch) -> str:
+    """"apply" for concrete values, "compile" when called under jit
+    tracing (the bracket then measures trace time, once per cache key)."""
+    return "compile" if is_tracing(batch) else "apply"
 
 
 class _Chainable:
@@ -188,8 +217,23 @@ class Pipeline(Transformer):
         return Pipeline(nodes=tuple(flat))
 
     def __call__(self, batch):
-        for node in self.nodes:
-            batch = node(batch)
+        if _events.active() is None:
+            for node in self.nodes:
+                batch = node(batch)
+            return batch
+        return self._call_observed(batch)
+
+    def _call_observed(self, batch):
+        """Per-node event-emitting apply (active sink only). Nodes that
+        carry their own instrumentation (observe.instrument wrappers)
+        record themselves — bracketing them again would double-count."""
+        phase = _call_phase(batch)
+        for i, node in enumerate(self.nodes):
+            if getattr(node, "_observe_instrumented", False):
+                batch = node(batch)
+                continue
+            with _node_span(_events.node_label(node, i), phase):
+                batch = node(batch)
         return batch
 
     def __iter__(self):
@@ -220,7 +264,9 @@ class Estimator:
 
     def fit_pipeline(self, data, **kw) -> Pipeline:
         """Fit and wrap the result as a single-node pipeline."""
-        return Pipeline.of(self.fit(data, **kw))
+        with _node_span(_events.node_label(self), "fit"):
+            fitted = self.fit(data, **kw)
+        return Pipeline.of(fitted)
 
     def then(self, nxt) -> "Estimator":
         return _SuffixedEstimator(est=self, suffix=_as_transformer(nxt))
@@ -302,7 +348,10 @@ class ChainedEstimator(Estimator):
     est: Estimator
 
     def fit(self, data, **kw) -> Pipeline:
-        model = self.est.fit(self.prefix(data), **kw)
+        with _node_span(_events.node_label(self.prefix), "apply"):
+            feats = self.prefix(data)
+        with _node_span(_events.node_label(self.est), "fit"):
+            model = self.est.fit(feats, **kw)
         return Pipeline.of(self.prefix, model)
 
     def fit_fused(self, data, **kw) -> Pipeline:
@@ -327,7 +376,10 @@ class ChainedLabelEstimator(LabelEstimator):
     est: LabelEstimator
 
     def fit(self, data, labels, **kw) -> Pipeline:
-        model = self.est.fit(self.prefix(data), labels, **kw)
+        with _node_span(_events.node_label(self.prefix), "apply"):
+            feats = self.prefix(data)
+        with _node_span(_events.node_label(self.est), "fit"):
+            model = self.est.fit(feats, labels, **kw)
         return Pipeline.of(self.prefix, model)
 
     def fit_fused(self, data, labels, **kw) -> Pipeline:
@@ -344,11 +396,21 @@ def _kw_key(kw: dict) -> tuple:
 
 
 @functools.partial(jax.jit, static_argnames=("kw",))
-def _fused_fit(chained, data, labels, kw):
+def _fused_fit_program(chained, data, labels, kw):
     feats = chained.prefix(data)
     if labels is None:
         return chained.est.fit(feats, **dict(kw))
     return chained.est.fit(feats, labels, **dict(kw))
+
+
+def _fused_fit(chained, data, labels, kw):
+    """The fused featurize+fit dispatch, bracketed as one "fit" node
+    (the prefix and estimator are a single XLA program here, so a
+    per-stage split would be fiction — the event records the fused
+    launch under the estimator's name)."""
+    name = _events.node_label(chained.est) + "+fused"
+    with _node_span(name, "fit"):
+        return _fused_fit_program(chained, data, labels, kw)
 
 
 class FunctionNode(_Chainable):

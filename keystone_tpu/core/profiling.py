@@ -8,11 +8,19 @@ The reference's observability is Spark's UI plus wall-clock brackets and
 - :func:`annotate` — name a region so it shows up in the trace timeline
   (the ``setName`` analog),
 - :func:`log_time` (re-exported from core.logging) — wall-clock brackets.
+
+``KEYSTONE_TRACE_DIR`` gates :func:`trace`: unset, the explicit
+``log_dir`` argument is used as before; set to a path, it is the default
+directory when no ``log_dir`` is passed; set to ``""``/``"0"``/``"off"``,
+tracing is a NO-OP even when a directory is passed — the production kill
+switch (a profiler failure must never take down a serving pipeline, and
+neither should a profiler at all when ops has it disabled).
 """
 
 from __future__ import annotations
 
 import contextlib
+import os
 
 import jax
 
@@ -20,16 +28,52 @@ from keystone_tpu.core.logging import get_logger, log_time  # noqa: F401
 
 logger = get_logger("keystone_tpu.profiling")
 
+ENV_TRACE_DIR = "KEYSTONE_TRACE_DIR"
+_DISABLED_VALUES = ("", "0", "off", "none")
+
+
+def _effective_trace_dir(log_dir: str | None) -> str | None:
+    env = os.environ.get(ENV_TRACE_DIR)
+    if env is not None and env.lower() in _DISABLED_VALUES:
+        return None  # explicit kill switch beats any argument
+    if log_dir:
+        return log_dir
+    return env or None
+
 
 @contextlib.contextmanager
-def trace(log_dir: str):
-    """Profile the enclosed block to ``log_dir`` (view with tensorboard)."""
-    jax.profiler.start_trace(log_dir)
+def trace(log_dir: str | None = None):
+    """Profile the enclosed block to ``log_dir`` (view with tensorboard).
+
+    Degrades instead of aborting: a failure inside
+    ``jax.profiler.start_trace`` (unwritable directory, a second
+    concurrent trace, a backend without profiler support) logs a warning
+    and runs the block unprofiled. No-op when gated off (module
+    docstring) or when no directory is configured at all.
+    """
+    log_dir = _effective_trace_dir(log_dir)
+    if log_dir is None:
+        yield
+        return
+    started = False
+    try:
+        jax.profiler.start_trace(log_dir)
+        started = True
+    except Exception as e:  # noqa: BLE001 — degrade, don't abort the run
+        logger.warning(
+            "profiler trace to %s unavailable (%r); running unprofiled",
+            log_dir,
+            e,
+        )
     try:
         yield
     finally:
-        jax.profiler.stop_trace()
-        logger.info("profile written to %s", log_dir)
+        if started:
+            try:
+                jax.profiler.stop_trace()
+                logger.info("profile written to %s", log_dir)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("profiler stop_trace failed: %r", e)
 
 
 def annotate(name: str):

@@ -31,6 +31,7 @@ from keystone_tpu.ops.linear import BlockLeastSquaresEstimator
 from keystone_tpu.ops.stats import LinearRectifier, PaddedFFT, RandomSignNode
 from keystone_tpu.ops.util import ClassLabelIndicators, MaxClassifier, ZipVectors
 from keystone_tpu.evaluation import MulticlassClassifierEvaluator
+from keystone_tpu.observe import events as observe_events
 from keystone_tpu.parallel.mesh import create_mesh, shard_batch
 
 logger = get_logger("keystone_tpu.models.mnist_random_fft")
@@ -305,6 +306,21 @@ def run(conf: MnistRandomFFTConfig, mesh=None) -> dict:
     model.apply_and_evaluate(test_blocks, streaming_eval("test", test_y, n_test))
     t_end = time.perf_counter()
 
+    ev = observe_events.active()
+    if ev is not None:
+        for phase, wall in (
+            ("load", t_load - t0),
+            ("featurize", t_feat - t_load),
+            ("fit", t_fit - t_feat),
+            ("eval", t_end - t_fit),
+        ):
+            ev.emit("phase", phase=phase, wall_s=wall)
+        try:
+            _record_observability(ev, batch_featurizers, model, test_x)
+        except Exception as e:  # noqa: BLE001 — observability must not
+            # fail a pipeline run that already trained and evaluated
+            logger.warning("observability recording failed: %r", e)
+
     result = {
         "train_error": errors["train"],
         "test_error": errors["test"],
@@ -324,6 +340,19 @@ def run(conf: MnistRandomFFTConfig, mesh=None) -> dict:
         result["train_samples_per_s"],
     )
     return result
+
+
+def _record_observability(ev, batch_featurizers, model, test_x) -> None:
+    """Per-node wall-time events + compiler cost profiles for the fitted
+    apply pipeline (featurizer bank → block model → argmax), recorded on
+    a bounded probe batch so observability cost stays a small constant.
+    This is the KeystoneML operator-profile sample for this pipeline."""
+    from keystone_tpu.observe.cost import record_pipeline_profile
+
+    bank = FeaturizerBank(batches=tuple(tuple(g) for g in batch_featurizers))
+    pipe = Pipeline.of(bank, model, MaxClassifier())
+    probe = test_x[: min(2048, test_x.shape[0])]
+    record_pipeline_profile(pipe, probe, save_dir=ev.run_dir)
 
 
 def main(argv=None) -> dict:

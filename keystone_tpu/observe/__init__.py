@@ -1,0 +1,40 @@
+"""Observability subsystem: metrics, structured run events, pipeline
+instrumentation, cost profiles, and run reports.
+
+KeystoneML's optimizer runs on per-operator runtime profiles; this
+package is that substrate for the TPU rebuild (see each module's
+docstring):
+
+- :mod:`.metrics` — process-wide labeled counters/gauges/timers
+- :mod:`.events` — JSONL run-event log, env-gated via
+  ``KEYSTONE_OBSERVE_DIR``
+- :mod:`.instrument` — ``instrument(pipeline)`` per-node wrappers
+- :mod:`.cost` — per-node FLOPs/bytes/memory profiles from
+  ``jax.jit(...).lower().compile().cost_analysis()``
+- :mod:`.report` — per-node run summary + the ``observe`` CLI
+
+``events`` and ``metrics`` are stdlib-light and imported eagerly (the
+core pipeline hooks depend on them); ``instrument``/``cost``/``report``
+import jax and the DSL, so they load lazily to keep
+``import keystone_tpu.observe.events`` cycle-free from ``core``.
+"""
+
+from __future__ import annotations
+
+from keystone_tpu.observe import events, metrics  # noqa: F401
+from keystone_tpu.observe.events import EventLog, node_label  # noqa: F401
+from keystone_tpu.observe.metrics import MetricsRegistry, get_registry  # noqa: F401
+
+_LAZY = {
+    "instrument": "keystone_tpu.observe.instrument",
+    "cost": "keystone_tpu.observe.cost",
+    "report": "keystone_tpu.observe.report",
+}
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+
+        return importlib.import_module(_LAZY[name])
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

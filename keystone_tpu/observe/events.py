@@ -1,0 +1,318 @@
+"""Structured run-event log — the per-operator execution record.
+
+KeystoneML's optimizer is driven by per-operator profiles sampled during
+execution; Spark's event log + UI is where those observations live. The
+TPU-native analog is this module: every pipeline node call (and coarse
+run phase) becomes one JSON line in ``<dir>/<run-id>/events.jsonl`` so a
+cost model, a report renderer, or plain ``jq`` can consume the run.
+
+Activation is env-gated and near-zero cost when off:
+
+- ``KEYSTONE_OBSERVE_DIR=/path`` — every process that touches the
+  pipeline DSL appends events under a fresh run directory there.
+- :func:`run` — explicit, scoped activation (the CLI launcher, bench,
+  and tests use this); restores the previous sink on exit.
+- disabled — :func:`active` is one module-global read returning None,
+  and the pipeline hooks take their plain fast path.
+
+Event schema (one JSON object per line; fields beyond these are free-form):
+
+==============  =========================================================
+``ts``          unix time (float, seconds)
+``run``         run id (shared by all events of one run)
+``event``       ``run_start`` | ``run_end`` | ``node`` | ``span`` |
+                ``phase`` | ``optimize`` | ``bench``
+``node``        node label (``node`` events), e.g. ``01:BlockLinearMapper``
+``phase``       ``fit`` | ``apply`` | ``compile`` (first traced call)
+``wall_s``      wall-clock duration of the bracket
+``status``      ``ok`` | ``failed`` (+ ``error`` repr when failed)
+==============  =========================================================
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import sys
+import threading
+import time
+import uuid
+from typing import Any, Iterator
+
+ENV_DIR = "KEYSTONE_OBSERVE_DIR"
+EVENTS_FILE = "events.jsonl"
+
+# in-memory mirror cap: a runaway loop must not grow the host heap
+# without bound just because observability is on
+_MAX_MEMORY_RECORDS = 100_000
+
+
+def node_label(node: Any, index: int | None = None) -> str:
+    """Stable display label for a pipeline node.
+
+    Shared by the pipeline hooks, :mod:`.instrument`, and :mod:`.cost` so
+    wall-time events and cost profiles join on the same key. The index
+    prefix keeps two like-typed nodes at different positions distinct.
+    """
+    name = getattr(node, "name", None)
+    if not name or not isinstance(name, str):
+        name = type(node).__name__
+    return f"{index:02d}:{name}" if index is not None else name
+
+
+class EventLog:
+    """A single run's event sink: JSONL file plus an in-memory mirror.
+
+    ``base_dir=None`` gives a memory-only log (bench uses this to build
+    per-node breakdowns without touching disk). All methods are
+    thread-safe; a failing disk write disables the file sink with one
+    warning rather than taking down the run.
+    """
+
+    def __init__(self, base_dir: str | None = None, run_id: str | None = None):
+        self.run_id = run_id or (
+            time.strftime("%Y%m%d-%H%M%S") + "-" + uuid.uuid4().hex[:6]
+        )
+        self.records: list[dict] = []
+        self.dropped = 0
+        self._lock = threading.Lock()
+        self._fh = None
+        self.run_dir: str | None = None
+        if base_dir:
+            self.run_dir = os.path.join(base_dir, self.run_id)
+            os.makedirs(self.run_dir, exist_ok=True)
+            self._fh = open(  # noqa: SIM115 — held for the run's lifetime
+                os.path.join(self.run_dir, EVENTS_FILE), "a", buffering=1
+            )
+
+    def emit(self, event: str, **fields: Any) -> dict:
+        rec = {"ts": time.time(), "run": self.run_id, "event": event}
+        rec.update(fields)
+        with self._lock:
+            if len(self.records) < _MAX_MEMORY_RECORDS:
+                self.records.append(rec)
+            else:
+                self.dropped += 1
+            if self._fh is not None:
+                # default=repr: a non-JSON field (numpy scalar, array) is
+                # a per-record problem — stringify it rather than losing
+                # the record, let alone the sink
+                try:
+                    line = json.dumps(rec, default=repr)
+                except ValueError:  # circular reference: skip this record
+                    line = None
+                if line is not None:
+                    try:
+                        self._fh.write(line + "\n")
+                    except OSError as e:
+                        self._fh = None
+                        from keystone_tpu.core.logging import get_logger
+
+                        get_logger("keystone_tpu.observe").warning(
+                            "event log write failed (%r); file sink disabled",
+                            e,
+                        )
+        return rec
+
+    @contextlib.contextmanager
+    def node(self, node: str, phase: str = "apply", **fields: Any) -> Iterator[None]:
+        """Bracket one node call: emits a ``node`` event with wall time
+        and status, re-raising any exception."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        except BaseException as e:
+            self.emit(
+                "node",
+                node=node,
+                phase=phase,
+                wall_s=time.perf_counter() - t0,
+                status="failed",
+                error=repr(e),
+                **fields,
+            )
+            raise
+        self.emit(
+            "node",
+            node=node,
+            phase=phase,
+            wall_s=time.perf_counter() - t0,
+            status="ok",
+            **fields,
+        )
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+
+
+# Lazy three-state active sink: _UNINIT → (EventLog | None) on first use,
+# so a process launched under KEYSTONE_OBSERVE_DIR self-activates and a
+# process without it pays one `is` check per pipeline call.
+_UNINIT: Any = object()
+_active: Any = _UNINIT
+_state_lock = threading.Lock()
+
+
+def active() -> EventLog | None:
+    """The currently active event log, or None. The ONLY check the hot
+    pipeline hooks make — keep it a plain read when initialized."""
+    global _active
+    log = _active
+    if log is _UNINIT:
+        with _state_lock:
+            if _active is _UNINIT:
+                base = os.environ.get(ENV_DIR)
+                try:
+                    _active = EventLog(base) if base else None
+                except OSError as e:
+                    # unwritable/full observe dir: observability must
+                    # degrade, not crash the pipeline at its first hook
+                    _active = None
+                    from keystone_tpu.core.logging import get_logger
+
+                    get_logger("keystone_tpu.observe").warning(
+                        "cannot open event log under %s (%r); "
+                        "observability disabled for this process",
+                        base,
+                        e,
+                    )
+                if _active is not None:
+                    _active.emit("run_start", source="env", argv=sys.argv)
+                    _close_at_exit(_active)
+            log = _active
+    return log
+
+
+def _close_at_exit(log: EventLog) -> None:
+    """Env-activated logs have no scoping context manager, so bracket
+    them at process exit: emit run_end (wall measured from activation)
+    and close the file — otherwise a report can't tell a completed run
+    from a crashed one. An uncaught exception is observed via a chained
+    ``sys.excepthook`` so the run_end carries status=failed. Known
+    limitation: CPython never invokes the excepthook for ``SystemExit``,
+    so env-activated runs aborted that way record status=ok — scoped
+    activation (:func:`run`, used by the launcher) brackets those
+    correctly."""
+    import atexit
+
+    t0 = time.perf_counter()
+    state: dict = {"status": "ok"}
+    prev_hook = sys.excepthook
+
+    def hook(tp, val, tb):
+        state["status"] = "failed"
+        state["error"] = f"{tp.__name__}: {val}"
+        prev_hook(tp, val, tb)
+
+    sys.excepthook = hook
+
+    def _finish() -> None:
+        try:
+            log.emit(
+                "run_end",
+                wall_s=time.perf_counter() - t0,
+                **state,
+            )
+            log.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+    atexit.register(_finish)
+
+
+def reset() -> None:
+    """Drop the active sink and re-arm env detection (tests, bench)."""
+    global _active
+    with _state_lock:
+        if isinstance(_active, EventLog):
+            _active.close()
+        _active = _UNINIT
+
+
+@contextlib.contextmanager
+def run(
+    base_dir: str | None = None, run_id: str | None = None, **meta: Any
+) -> Iterator[EventLog]:
+    """Scoped activation: install a fresh :class:`EventLog` as the active
+    sink, bracket it with ``run_start``/``run_end`` events, and restore
+    the previous sink (including the lazy-env sentinel) on exit.
+
+    ``base_dir=None`` falls back to ``KEYSTONE_OBSERVE_DIR``; if that is
+    unset too, the log is memory-only (still yielded, still active).
+    """
+    global _active
+    if base_dir is None:
+        base_dir = os.environ.get(ENV_DIR) or None
+    try:
+        log = EventLog(base_dir, run_id)
+    except OSError as e:
+        # same degrade invariant as env activation: a broken observe dir
+        # must not abort the run — continue with a memory-only log
+        from keystone_tpu.core.logging import get_logger
+
+        get_logger("keystone_tpu.observe").warning(
+            "cannot open event log under %s (%r); continuing memory-only",
+            base_dir,
+            e,
+        )
+        log = EventLog(None, run_id)
+    with _state_lock:
+        prev = _active
+        _active = log
+    log.emit("run_start", **meta)
+    t0 = time.perf_counter()
+    try:
+        yield log
+    except BaseException as e:
+        log.emit(
+            "run_end",
+            wall_s=time.perf_counter() - t0,
+            status="failed",
+            error=repr(e),
+        )
+        raise
+    else:
+        log.emit("run_end", wall_s=time.perf_counter() - t0, status="ok")
+    finally:
+        with _state_lock:
+            _active = prev
+        log.close()
+
+
+def resolve_run_dir(path: str) -> str:
+    """Accept either a run directory (contains ``events.jsonl``) or a
+    base observe directory (pick the newest run under it)."""
+    if os.path.isfile(os.path.join(path, EVENTS_FILE)):
+        return path
+    candidates = [
+        os.path.join(path, d)
+        for d in os.listdir(path)
+        if os.path.isfile(os.path.join(path, d, EVENTS_FILE))
+    ]
+    if not candidates:
+        raise FileNotFoundError(f"no {EVENTS_FILE} under {path!r}")
+    return max(candidates, key=os.path.getmtime)
+
+
+def read_events(path: str) -> list[dict]:
+    """Parse a run's ``events.jsonl`` (corrupt lines are skipped — a
+    crashed writer must not make the whole run unreadable)."""
+    run_dir = resolve_run_dir(path)
+    out: list[dict] = []
+    with open(os.path.join(run_dir, EVENTS_FILE)) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue
+    return out

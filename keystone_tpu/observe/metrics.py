@@ -1,0 +1,147 @@
+"""Process-wide metrics registry: counters, gauges, and timers.
+
+The in-process aggregate view that complements the per-event record in
+:mod:`.events` — the event log answers "what happened when", this
+answers "how many / how long in total". Series are keyed by metric name
+plus a frozen label set (``counter("node_calls", node="01:Pooler")``),
+mirroring the Prometheus data model without the dependency.
+
+Everything is thread-safe: the registry guards series creation, each
+series guards its own updates, so concurrent pipeline calls (e.g. the
+streaming loaders' decode-ahead thread) can record freely.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Any, Iterator
+
+
+def _series_key(name: str, labels: dict[str, Any]) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
+class Counter:
+    """Monotonically increasing count."""
+
+    __slots__ = ("_lock", "value")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def inc(self, n: int | float = 1) -> None:
+        with self._lock:
+            self.value += n
+
+
+class Gauge:
+    """Last-write-wins instantaneous value."""
+
+    __slots__ = ("_lock", "value")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self.value = v
+
+
+class Timer:
+    """Duration summary: count / total / min / max seconds."""
+
+    __slots__ = ("_lock", "count", "total", "min", "max")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.count = 0
+        self.total = 0.0
+        self.min = float("inf")
+        self.max = 0.0
+
+    def observe(self, seconds: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.total += seconds
+            self.min = min(self.min, seconds)
+            self.max = max(self.max, seconds)
+
+    @contextlib.contextmanager
+    def time(self) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(time.perf_counter() - t0)
+
+    def summary(self) -> dict:
+        with self._lock:
+            mean = self.total / self.count if self.count else 0.0
+            return {
+                "count": self.count,
+                "total_s": self.total,
+                "mean_s": mean,
+                "min_s": self.min if self.count else 0.0,
+                "max_s": self.max,
+            }
+
+
+class MetricsRegistry:
+    """Get-or-create home of all labeled series in one process."""
+
+    _KINDS = {"counter": Counter, "gauge": Gauge, "timer": Timer}
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._series: dict[str, tuple[str, Any]] = {}
+
+    def _get(self, kind: str, name: str, labels: dict[str, Any]):
+        key = _series_key(name, labels)
+        with self._lock:
+            hit = self._series.get(key)
+            if hit is None:
+                hit = (kind, self._KINDS[kind]())
+                self._series[key] = hit
+            elif hit[0] != kind:
+                raise ValueError(
+                    f"metric {key!r} already registered as {hit[0]}, not {kind}"
+                )
+            return hit[1]
+
+    def counter(self, name: str, **labels: Any) -> Counter:
+        return self._get("counter", name, labels)
+
+    def gauge(self, name: str, **labels: Any) -> Gauge:
+        return self._get("gauge", name, labels)
+
+    def timer(self, name: str, **labels: Any) -> Timer:
+        return self._get("timer", name, labels)
+
+    def snapshot(self) -> dict[str, Any]:
+        """Point-in-time dump: series key → value (counters/gauges) or
+        summary dict (timers)."""
+        with self._lock:
+            items = list(self._series.items())
+        out: dict[str, Any] = {}
+        for key, (kind, series) in items:
+            out[key] = series.summary() if kind == "timer" else series.value
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._series.clear()
+
+
+_registry = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide default registry (instrumentation records here)."""
+    return _registry

@@ -1,0 +1,232 @@
+"""Run-report renderer: ``python -m keystone_tpu observe <run-dir>``.
+
+Joins a run's wall-time events (:mod:`.events`) with its per-node cost
+profiles (:mod:`.cost`) into the KeystoneML-style operator summary: per
+node — calls, total/mean wall time, share of run, modeled GFLOPs and
+bytes from ``cost_analysis()``, achieved FLOP/s, and the fraction of the
+chip's bf16 peak (roofline basis: ROOFLINE.md — one v5e chip ≈ 197 TF/s
+bf16, HBM ≈ 819 GB/s; CPU runs have no peak entry and show ``-``).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any
+
+from keystone_tpu.observe import cost as _cost
+from keystone_tpu.observe import events as _events
+
+# bf16 MXU peak per chip, keyed by device_kind substring — the ONE home
+# of the roofline basis (ROOFLINE.md; the f32 MXU rate is lower, so f32
+# workloads report conservative MFU on this basis). bench.py and
+# tools/mfu_sweep.py import these rather than carrying copies.
+PEAK_FLOPS = {"v5 lite": 197e12, "v5p": 459e12, "v4": 275e12}
+HBM_BYTES_PER_S = 819e9
+
+
+def peak_flops_for(device_kind: str | None) -> float | None:
+    """bf16 peak for a jax ``device_kind`` string, or None when unknown
+    (CPU, new chip generations)."""
+    if not device_kind:
+        return None
+    kind = device_kind.lower()
+    for key, peak in PEAK_FLOPS.items():
+        if key in kind:
+            return peak
+    return None
+
+
+def summarize(events: list[dict]) -> dict[str, Any]:
+    """Aggregate a run's events: per-node execute stats, compile brackets,
+    coarse phases/spans, and run metadata."""
+    nodes: dict[str, dict] = {}
+    compiles: dict[str, float] = {}
+    phases: list[dict] = []
+    spans: list[dict] = []
+    meta: dict[str, Any] = {"run": None, "wall_s": None, "status": None}
+    for ev in events:
+        kind = ev.get("event")
+        if meta["run"] is None and ev.get("run"):
+            meta["run"] = ev["run"]
+        if kind == "node":
+            label = str(ev.get("node", "?"))
+            if ev.get("phase") == "compile":
+                compiles[label] = compiles.get(label, 0.0) + ev.get("wall_s", 0.0)
+                continue
+            stat = nodes.setdefault(
+                label,
+                {"calls": 0, "total_s": 0.0, "max_s": 0.0, "failed": 0,
+                 "phase": ev.get("phase", "apply")},
+            )
+            stat["calls"] += 1
+            stat["total_s"] += ev.get("wall_s", 0.0)
+            stat["max_s"] = max(stat["max_s"], ev.get("wall_s", 0.0))
+            if ev.get("status") != "ok":
+                stat["failed"] += 1
+        elif kind == "phase":
+            phases.append(ev)
+        elif kind == "span":
+            spans.append(ev)
+        elif kind == "run_end":
+            meta["wall_s"] = ev.get("wall_s")
+            meta["status"] = ev.get("status")
+    return {
+        "meta": meta,
+        "nodes": nodes,
+        "compiles": compiles,
+        "phases": phases,
+        "spans": spans,
+    }
+
+
+def _fmt(value: float | None, scale: float = 1.0, digits: int = 2) -> str:
+    if value is None:
+        return "-"
+    return f"{value / scale:.{digits}f}"
+
+
+def render(run_dir: str) -> str:
+    """The full text report for one run directory.
+
+    The GFLOP/s and vs_peak columns assume the counted calls processed
+    batches of the shape the cost profile was lowered for (the probe
+    batch in the standard ``record_pipeline_profile`` flow); calls on
+    other batch sizes shift those two columns by the size ratio — the
+    wall-time columns are always measured truth.
+    """
+    # resolve ONCE so events and cost profiles come from the same run
+    # even if a concurrent process appends a newer run mid-render
+    run_dir = _events.resolve_run_dir(run_dir)
+    events = _events.read_events(run_dir)
+    summary = summarize(events)
+    costs = _cost.load_profiles(run_dir)
+    profiles = costs.get("profiles", {})
+    peak = peak_flops_for(costs.get("device_kind"))
+
+    lines: list[str] = []
+    meta = summary["meta"]
+    dev = costs.get("device_kind") or "unknown"
+    ndev = costs.get("num_devices")
+    lines.append(
+        f"run {meta['run'] or '?'}  [{run_dir}]  "
+        f"device={dev}{f' x{ndev}' if ndev else ''}  "
+        f"events={len(events)}"
+        + (f"  wall={meta['wall_s']:.2f}s" if meta["wall_s"] else "")
+        + (f"  status={meta['status']}" if meta["status"] else "")
+    )
+    lines.append("")
+
+    nodes = summary["nodes"]
+    labels = sorted(set(nodes) | set(profiles))
+    if labels:
+        total_wall = sum(s["total_s"] for s in nodes.values()) or None
+        header = (
+            f"{'node':36} {'phase':7} {'calls':>5} {'total_s':>8} "
+            f"{'mean_ms':>8} {'share%':>6} {'GFLOP':>9} {'MB_acc':>9} "
+            f"{'GFLOP/s':>8} {'vs_peak':>7}"
+        )
+        lines.append(header)
+        lines.append("-" * len(header))
+        for label in labels:
+            stat = nodes.get(label)
+            prof = profiles.get(label, {})
+            flops = prof.get("flops")
+            bytes_acc = prof.get("bytes_accessed")
+            calls = stat["calls"] if stat else 0
+            total = stat["total_s"] if stat else None
+            mean = total / calls if stat and calls else None
+            share = (
+                100.0 * total / total_wall if total is not None and total_wall else None
+            )
+            rate = (
+                flops * calls / total
+                if flops is not None and total
+                else None
+            )
+            vs_peak = rate / peak if rate is not None and peak else None
+            failed = f" ({stat['failed']} FAILED)" if stat and stat["failed"] else ""
+            lines.append(
+                f"{label:36} {(stat or {}).get('phase', '-'):7} {calls:>5} "
+                f"{_fmt(total, digits=3):>8} {_fmt(mean, 1e-3, 1):>8} "
+                f"{_fmt(share, digits=1):>6} {_fmt(flops, 1e9):>9} "
+                f"{_fmt(bytes_acc, 1e6):>9} {_fmt(rate, 1e9, 1):>8} "
+                f"{_fmt(vs_peak, digits=4):>7}{failed}"
+            )
+        lines.append("")
+
+    if summary["compiles"]:
+        lines.append("compile (first traced call):")
+        for label, secs in sorted(summary["compiles"].items()):
+            lines.append(f"  {label:36} {secs:8.3f}s")
+        lines.append("")
+    if summary["phases"]:
+        lines.append("phases:")
+        for ev in summary["phases"]:
+            lines.append(
+                f"  {str(ev.get('phase', '?')):16} "
+                f"{ev.get('wall_s', 0.0):8.3f}s"
+            )
+        lines.append("")
+    if summary["spans"]:
+        lines.append("spans (log_time):")
+        for ev in summary["spans"]:
+            status = "" if ev.get("status") == "ok" else "  FAILED"
+            lines.append(
+                f"  {str(ev.get('label', '?')):36} "
+                f"{ev.get('wall_s', 0.0):8.3f}s{status}"
+            )
+        lines.append("")
+    if peak is None and profiles:
+        lines.append(
+            "(no bf16 peak known for this device kind — vs_peak omitted; "
+            "roofline basis: ROOFLINE.md)"
+        )
+    return "\n".join(lines)
+
+
+def per_node_breakdown(
+    log: "_events.EventLog",
+    profiles: dict[str, dict] | None = None,
+    since: int = 0,
+) -> dict[str, dict]:
+    """Compact per-node dict for embedding in machine artifacts (bench):
+    node label → calls/wall plus flops/bytes when profiled. ``since``
+    restricts to records appended after that index — pass the record
+    count captured before your instrumented apply when reusing an
+    ambient log, so unrelated earlier events don't leak in."""
+    summary = summarize(log.records[since:])
+    out: dict[str, dict] = {}
+    for label, stat in summary["nodes"].items():
+        entry = {
+            "calls": stat["calls"],
+            "wall_s": round(stat["total_s"], 6),
+        }
+        prof = (profiles or {}).get(label, {})
+        if "flops" in prof:
+            entry["flops"] = prof["flops"]
+        if "bytes_accessed" in prof:
+            entry["bytes_accessed"] = prof["bytes_accessed"]
+        out[label] = entry
+    if not out and getattr(log, "dropped", 0):
+        # the in-memory mirror hit its cap before these events: say so
+        # rather than returning {} that reads as "no nodes ran" (the
+        # file sink, when present, still has the full record)
+        return {
+            "error": f"{log.dropped} event records dropped (in-memory cap)"
+        }
+    return out
+
+
+def main(argv: list[str] | None = None) -> None:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        raise SystemExit(
+            "usage: python -m keystone_tpu observe <run-dir>\n"
+            "<run-dir> is a directory containing events.jsonl, or a base\n"
+            "KEYSTONE_OBSERVE_DIR (the newest run under it is rendered)"
+        )
+    try:
+        print(render(argv[0]))
+    except OSError as e:
+        # missing dir, events.jsonl passed instead of its directory, ...
+        raise SystemExit(str(e)) from None

@@ -1,0 +1,428 @@
+"""Observability subsystem tests (observe/*, core logging/profiling
+satellites, and the ``observe`` CLI path).
+
+Reference: KeystoneML's optimizer consumes per-operator runtime profiles;
+these tests pin the TPU rebuild's substrate for that — metrics registry,
+JSONL event log, pipeline instrumentation, and compiler cost profiles.
+"""
+
+import json
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from keystone_tpu import LabelEstimator, Pipeline, transformer
+from keystone_tpu.observe import events, metrics
+from keystone_tpu.observe.cost import CostProfileRegistry, analyze, load_profiles
+from keystone_tpu.observe.instrument import instrument
+
+
+def three_node_pipe():
+    return (
+        transformer(lambda b: b + 1.0, "add1")
+        >> transformer(lambda b: b * 2.0, "mul2")
+        >> transformer(lambda b: b - 0.5, "sub")
+    )
+
+
+# ---------------------------------------------------------------- metrics
+
+
+def test_counter_gauge_timer_and_labels():
+    reg = metrics.MetricsRegistry()
+    reg.counter("calls", node="a").inc()
+    reg.counter("calls", node="a").inc(2)
+    reg.counter("calls", node="b").inc()
+    reg.gauge("hbm").set(42.5)
+    t = reg.timer("secs", node="a")
+    t.observe(0.25)
+    t.observe(0.75)
+    snap = reg.snapshot()
+    assert snap["calls{node=a}"] == 3
+    assert snap["calls{node=b}"] == 1
+    assert snap["hbm"] == 42.5
+    summary = snap["secs{node=a}"]
+    assert summary["count"] == 2
+    assert summary["total_s"] == pytest.approx(1.0)
+    assert summary["min_s"] == 0.25 and summary["max_s"] == 0.75
+    # same key, different kind → error, not silent aliasing
+    with pytest.raises(ValueError):
+        reg.gauge("calls", node="a")
+
+
+def test_timer_time_context_counts_failures_too():
+    reg = metrics.MetricsRegistry()
+    t = reg.timer("bracket")
+    with pytest.raises(RuntimeError):
+        with t.time():
+            raise RuntimeError("boom")
+    assert t.count == 1
+
+
+def test_metrics_thread_safety():
+    reg = metrics.MetricsRegistry()
+    n_threads, n_incs = 8, 2000
+
+    def work():
+        c = reg.counter("hammer", src="t")
+        timer = reg.timer("hammer_s", src="t")
+        for _ in range(n_incs):
+            c.inc()
+            timer.observe(0.001)
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert reg.counter("hammer", src="t").value == n_threads * n_incs
+    assert reg.timer("hammer_s", src="t").count == n_threads * n_incs
+
+
+# ----------------------------------------------------------------- events
+
+
+def test_event_log_jsonl_roundtrip(tmp_path):
+    with events.run(str(tmp_path), workload="unit") as log:
+        log.emit("node", node="00:x", phase="apply", wall_s=0.5, status="ok")
+        with log.node("01:y", "fit"):
+            pass
+        run_dir = log.run_dir
+    evs = events.read_events(run_dir)
+    kinds = [e["event"] for e in evs]
+    assert kinds[0] == "run_start" and kinds[-1] == "run_end"
+    assert evs[0]["workload"] == "unit"
+    nodes = [e for e in evs if e["event"] == "node"]
+    assert len(nodes) == 2
+    assert nodes[1]["node"] == "01:y" and nodes[1]["phase"] == "fit"
+    assert nodes[1]["status"] == "ok" and nodes[1]["wall_s"] >= 0
+    assert all(e["run"] == evs[0]["run"] for e in evs)
+    # base-dir resolution picks this run
+    assert events.resolve_run_dir(str(tmp_path)) == run_dir
+
+
+def test_event_node_bracket_records_failure(tmp_path):
+    with events.run(str(tmp_path)) as log:
+        with pytest.raises(ValueError):
+            with log.node("00:bad", "apply"):
+                raise ValueError("nope")
+        run_dir = log.run_dir
+    nodes = [e for e in events.read_events(run_dir) if e["event"] == "node"]
+    assert nodes[0]["status"] == "failed" and "nope" in nodes[0]["error"]
+    # the run itself completed
+    end = [e for e in events.read_events(run_dir) if e["event"] == "run_end"]
+    assert end[0]["status"] == "ok"
+
+
+def test_env_gated_activation(tmp_path, monkeypatch):
+    try:
+        monkeypatch.setenv(events.ENV_DIR, str(tmp_path))
+        events.reset()
+        log = events.active()
+        assert log is not None and log.run_dir.startswith(str(tmp_path))
+        assert events.active() is log  # cached, not re-created
+    finally:
+        monkeypatch.delenv(events.ENV_DIR, raising=False)
+        events.reset()
+    assert events.active() is None
+
+
+def test_run_restores_previous_sink(tmp_path):
+    assert events.active() is None
+    with events.run(str(tmp_path)) as outer:
+        with events.run(str(tmp_path)) as inner:
+            assert events.active() is inner
+        assert events.active() is outer
+    assert events.active() is None
+
+
+# ------------------------------------------------------- instrumentation
+
+
+def test_instrument_preserves_outputs_bit_exactly_and_records(tmp_path):
+    pipe = three_node_pipe()
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=(32, 8)).astype(np.float32)
+    )
+    expect = np.asarray(pipe(x))
+    with events.run(str(tmp_path)) as log:
+        inst = instrument(pipe, sync=True)
+        got1 = np.asarray(inst(x))
+        got2 = np.asarray(inst(x))
+        run_dir = log.run_dir
+    assert np.array_equal(got1, expect) and np.array_equal(got2, expect)
+    nodes = [e for e in events.read_events(run_dir) if e["event"] == "node"]
+    per_label = {}
+    for e in nodes:
+        per_label[e["node"]] = per_label.get(e["node"], 0) + 1
+    # one entry per node per call — 3 nodes × 2 calls, no double counting
+    # from the Pipeline.__call__ hook (instrumented nodes self-record)
+    assert per_label == {"00:add1": 2, "01:mul2": 2, "02:sub": 2}
+    assert all("wall_s" in e and e["status"] == "ok" for e in nodes)
+    # metrics registry saw the same calls
+    snap = metrics.get_registry().snapshot()
+    assert snap["node_calls{node=00:add1}"] >= 2
+
+
+def test_instrument_is_idempotent_but_honors_sync_change():
+    pipe = three_node_pipe()
+    once = instrument(pipe, sync=False)
+    twice = instrument(once, sync=False)
+    assert all(a is b for a, b in zip(once.nodes, twice.nodes))
+    resynced = instrument(once, sync=True)
+    assert all(n.sync for n in resynced.nodes)
+    assert [n.inner for n in resynced.nodes] == [n.inner for n in once.nodes]
+
+
+def test_pipeline_call_hook_emits_per_node_events(tmp_path):
+    pipe = three_node_pipe()
+    x = jnp.ones((4, 4))
+    with events.run(str(tmp_path)) as log:
+        pipe(x)
+        run_dir = log.run_dir
+    labels = [
+        e["node"] for e in events.read_events(run_dir) if e["event"] == "node"
+    ]
+    assert labels == ["00:add1", "01:mul2", "02:sub"]
+    # disabled: no sink, no events, same output
+    out = pipe(x)
+    assert np.asarray(out).shape == (4, 4)
+
+
+def test_jitted_instrumented_pipeline_records_compile_phase(tmp_path):
+    pipe = three_node_pipe()
+    x = jnp.ones((8, 4))
+    expect = np.asarray(pipe(x))
+    with events.run(str(tmp_path)) as log:
+        inst = instrument(pipe)
+        jit_apply = jax.jit(lambda p, b: p(b))
+        got = np.asarray(jit_apply(inst, x))
+        run_dir = log.run_dir
+    assert np.array_equal(got, expect)
+    phases = {
+        e["phase"] for e in events.read_events(run_dir) if e["event"] == "node"
+    }
+    assert "compile" in phases
+
+
+def test_chained_fit_hooks_emit_fit_events(tmp_path):
+    class MeanEst(LabelEstimator):
+        def fit(self, data, labels):
+            mu = jnp.mean(labels)
+            return transformer(lambda b, mu=mu: b * mu, name="scaled")
+
+    data = jnp.ones((8, 3))
+    labels = jnp.full((8,), 2.0)
+    chained = transformer(lambda b: b + 1.0, "shift") >> MeanEst()
+    with events.run(str(tmp_path)) as log:
+        chained.fit(data, labels)
+        run_dir = log.run_dir
+    nodes = [e for e in events.read_events(run_dir) if e["event"] == "node"]
+    by_phase = {e["phase"]: e["node"] for e in nodes}
+    assert by_phase.get("fit") == "MeanEst"
+    assert by_phase.get("apply") == "shift"
+
+
+# ------------------------------------------------------------------ cost
+
+
+def test_cost_profile_of_jitted_matmul():
+    a = jnp.ones((128, 256), jnp.float32)
+    b = jnp.ones((256, 64), jnp.float32)
+    profile = analyze(lambda a, b: a @ b, a, b)
+    assert "error" not in profile
+    # 2*M*K*N FLOPs for the matmul, as modeled by cost_analysis()
+    assert profile["flops"] == pytest.approx(2 * 128 * 256 * 64, rel=0.01)
+    assert profile["bytes_accessed"] > 0
+    if "peak_bytes" in profile:  # memory_analysis available on this backend
+        assert profile["output_bytes"] == 128 * 64 * 4
+
+
+def test_cost_registry_pipeline_profiles_roundtrip(tmp_path):
+    pipe = transformer(lambda b: b @ jnp.ones((8, 16)), "proj") >> transformer(
+        lambda b: jnp.maximum(b, 0.0), "relu"
+    )
+    reg = CostProfileRegistry()
+    profiles = reg.profile_pipeline(pipe, jnp.ones((32, 8)))
+    assert set(profiles) == {"00:proj", "01:relu"}
+    assert profiles["00:proj"]["flops"] > 0
+    assert profiles["00:proj"]["input_shapes"] == ["float32[32, 8]"]
+    path = reg.save(str(tmp_path))
+    loaded = load_profiles(str(tmp_path))
+    assert loaded["profiles"]["00:proj"]["flops"] == profiles["00:proj"]["flops"]
+    assert loaded["device_kind"] == "cpu"
+    assert os.path.basename(path) == "cost_profiles.json"
+    # unanalyzable node degrades to an error profile, not an exception
+    bad = transformer(lambda b: np.asarray(b).tolist(), "host_op")
+    assert "error" in CostProfileRegistry().profile_node(bad, jnp.ones(3))
+
+
+# -------------------------------------------------------- report and CLI
+
+
+def _make_run(tmp_path):
+    pipe = three_node_pipe()
+    x = jnp.ones((64, 32))
+    with events.run(str(tmp_path)) as log:
+        instrument(pipe, sync=True)(x)
+        reg = CostProfileRegistry()
+        reg.profile_pipeline(pipe, x)
+        reg.save(log.run_dir)
+        return log.run_dir
+
+
+def test_observe_cli_renders_per_node_summary(tmp_path, capsys):
+    run_dir = _make_run(tmp_path)
+    from keystone_tpu.__main__ import main as cli_main
+
+    cli_main(["observe", run_dir])
+    out = capsys.readouterr().out
+    assert "00:add1" in out and "01:mul2" in out and "02:sub" in out
+    assert "GFLOP" in out and "MB_acc" in out  # cost_analysis columns
+    assert "calls" in out
+    # base-dir form resolves to the newest run
+    cli_main(["observe", str(tmp_path)])
+    assert "00:add1" in capsys.readouterr().out
+
+
+def test_observe_cli_usage_and_missing_dir(tmp_path):
+    from keystone_tpu.__main__ import main as cli_main
+
+    with pytest.raises(SystemExit):
+        cli_main(["observe"])
+    with pytest.raises(SystemExit):
+        cli_main(["observe", str(tmp_path / "nowhere")])
+
+
+def test_per_node_breakdown_compact_dict(tmp_path):
+    pipe = three_node_pipe()
+    from keystone_tpu.observe.report import per_node_breakdown
+
+    with events.run() as log:  # memory-only: no dir
+        instrument(pipe, sync=True)(jnp.ones((16, 4)))
+        breakdown = per_node_breakdown(log)
+    assert set(breakdown) == {"00:add1", "01:mul2", "02:sub"}
+    assert all(v["calls"] == 1 and v["wall_s"] >= 0 for v in breakdown.values())
+
+
+# ------------------------------------------- logging/profiling satellites
+
+
+def test_log_time_emits_duration_on_failure(tmp_path):
+    from keystone_tpu.core.logging import log_time
+
+    with events.run(str(tmp_path)) as log:
+        with pytest.raises(KeyError):
+            with log_time("doomed step"):
+                raise KeyError("x")
+        with log_time("fine step"):
+            pass
+        run_dir = log.run_dir
+    spans = [e for e in events.read_events(run_dir) if e["event"] == "span"]
+    assert len(spans) == 2
+    assert spans[0]["label"] == "doomed step" and spans[0]["status"] == "failed"
+    assert spans[1]["status"] == "ok"
+    assert all(e["wall_s"] >= 0 for e in spans)
+
+
+def test_get_logger_honors_env_level_and_is_idempotent(monkeypatch):
+    import keystone_tpu.core.logging as klog
+
+    root = __import__("logging").getLogger("keystone_tpu")
+    saved_level, saved_handlers = root.level, list(root.handlers)
+    try:
+        root.handlers = []
+        monkeypatch.setattr(klog, "_CONFIGURED", False)
+        monkeypatch.setenv("KEYSTONE_LOG_LEVEL", "DEBUG")
+        results = []
+
+        def configure():
+            results.append(klog.get_logger("keystone_tpu.test"))
+
+        threads = [threading.Thread(target=configure) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert root.level == 10  # DEBUG
+        assert len(root.handlers) == 1  # concurrent first calls: ONE handler
+    finally:
+        root.level = saved_level
+        root.handlers = saved_handlers
+
+
+def test_trace_env_gate_and_degraded_start(monkeypatch, tmp_path):
+    from keystone_tpu.core import profiling
+
+    calls = []
+    monkeypatch.setattr(
+        jax.profiler, "start_trace", lambda d: calls.append(d)
+    )
+    monkeypatch.setattr(jax.profiler, "stop_trace", lambda: None)
+    # kill switch: explicit dir is still a no-op
+    monkeypatch.setenv(profiling.ENV_TRACE_DIR, "0")
+    with profiling.trace(str(tmp_path)):
+        pass
+    assert calls == []
+    # env provides the default dir when enabled
+    monkeypatch.setenv(profiling.ENV_TRACE_DIR, str(tmp_path))
+    with profiling.trace():
+        pass
+    assert calls == [str(tmp_path)]
+    # a failing start_trace degrades to a warning, not an abort
+    def boom(d):
+        raise RuntimeError("dir not writable")
+
+    monkeypatch.setattr(jax.profiler, "start_trace", boom)
+    ran = []
+    with profiling.trace(str(tmp_path)):
+        ran.append(True)
+    assert ran == [True]
+
+
+def test_fusion_pass_records_rewrite(tmp_path):
+    from keystone_tpu.core.fusion import optimize
+    from keystone_tpu.ops.images import (
+        Convolver,
+        ImageVectorizer,
+        Pooler,
+        SymmetricRectifier,
+    )
+
+    rng = np.random.default_rng(0)
+    filters = jnp.asarray(rng.normal(size=(4, 27)).astype(np.float32))
+    means = jnp.asarray(rng.normal(size=(27,)).astype(np.float32))
+    pipe = (
+        Convolver(
+            filters=filters,
+            whitener_means=means,
+            patch_size=3,
+            normalize_patches=True,
+        )
+        >> SymmetricRectifier(alpha=0.25)
+        >> Pooler(stride=13, pool_size=14)
+        >> ImageVectorizer()
+    )
+    before = metrics.get_registry().counter(
+        "fusion_rewrites", rule="conv_rectify_pool"
+    ).value
+    with events.run(str(tmp_path)) as log:
+        optimize(pipe)
+        run_dir = log.run_dir
+    after = metrics.get_registry().counter(
+        "fusion_rewrites", rule="conv_rectify_pool"
+    ).value
+    assert after == before + 1
+    opt = [e for e in events.read_events(run_dir) if e["event"] == "optimize"]
+    assert opt and opt[0]["nodes_before"] == 4 and opt[0]["nodes_after"] == 2
+
+
+def test_events_file_lines_are_valid_json(tmp_path):
+    run_dir = _make_run(tmp_path)
+    with open(os.path.join(run_dir, events.EVENTS_FILE)) as f:
+        for line in f:
+            json.loads(line)

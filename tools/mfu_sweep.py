@@ -30,7 +30,7 @@ NUM_FFTS = 4
 D_FEAT = 2048
 CLASSES = 10
 
-PEAK_FLOPS = {"v5 lite": 197e12, "v5p": 459e12, "v4": 275e12}
+# roofline basis lives in keystone_tpu.observe.report (single home)
 
 
 def _sync(x) -> float:
@@ -104,10 +104,9 @@ def main() -> None:
 
     enable_compilation_cache()
     dev = jax.devices()[0]
-    peak = next(
-        (v for k, v in PEAK_FLOPS.items() if k in dev.device_kind.lower()),
-        None,
-    )
+    from keystone_tpu.observe.report import peak_flops_for
+
+    peak = peak_flops_for(dev.device_kind)
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.normal(size=(N, D_IMG)).astype(np.float32))
     feats = m.build_batch_featurizers(NUM_FFTS, D_FEAT, seed=0)
