@@ -832,19 +832,41 @@ def _start_probe():
 
 def _accelerator_alive(timeout_s: float = 120.0, attempts: int = 3) -> bool:
     """Up to ``attempts`` probe subprocesses with backoff — one transient
-    tunnel hiccup must not cost the round its TPU number."""
-    for i in range(attempts):
+    tunnel hiccup must not cost the round its TPU number. The schedule
+    is the shared RetryPolicy (5 s then 10 s, jitter-free to keep the
+    historical cadence), so probe retries land in the event log like
+    every other resilience decision."""
+    from keystone_tpu.resilience.retry import RetryExhausted, RetryPolicy
+
+    def _probe_once():
         proc = _start_probe()
         if proc is None:
-            return False
+            raise _ProbeSpawnFailed()
         try:
-            if proc.wait(timeout=timeout_s) == 0:
-                return True
-        except Exception:  # noqa: BLE001 — still hung
+            code = proc.wait(timeout=timeout_s)
+        except Exception as e:  # noqa: BLE001 — still hung
             proc.kill()
-        if i + 1 < attempts:
-            time.sleep(5.0 * (i + 1))
-    return False
+            raise OSError(f"accelerator probe hung >{timeout_s:.0f}s") from e
+        if code != 0:
+            raise OSError(f"accelerator probe exited {code}")
+
+    policy = RetryPolicy(
+        max_attempts=attempts,
+        base_delay_s=5.0,
+        multiplier=2.0,
+        max_delay_s=15.0,
+        jitter=0.0,
+        classify=lambda e: isinstance(e, OSError),
+    )
+    try:
+        policy.call(_probe_once, label="accel.probe")
+        return True
+    except (RetryExhausted, _ProbeSpawnFailed):
+        return False
+
+
+class _ProbeSpawnFailed(Exception):
+    """Probe subprocess could not even spawn — not transient, no retry."""
 
 
 def _device_peak() -> float | None:

@@ -54,6 +54,7 @@ PIPELINES = {
 # non-pipeline subcommands: short name → module whose ``main(argv)`` runs
 COMMANDS = {
     "observe": "keystone_tpu.observe.report",
+    "faults": "keystone_tpu.resilience.faults",
 }
 
 
@@ -94,7 +95,8 @@ def main(argv: list[str] | None = None) -> None:
             f" are also accepted; --multihost joins this process into the\n"
             f" jax.distributed runtime before dispatch — run the same command"
             f" on every host; --observe DIR writes a structured per-node\n"
-            f" event log there, rendered by `observe <dir>`)"
+            f" event log there, rendered by `observe <dir>`; `faults --list`\n"
+            f" prints the KEYSTONE_FAULTS injection sites)"
         )
     if argv[0] in COMMANDS:
         import importlib

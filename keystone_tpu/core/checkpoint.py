@@ -116,14 +116,26 @@ def _check_meta(
 def _restore_leaves(mgr, step, template, checkpoint_dir, what: str):
     """Restore ``step``'s leaves into ``template``'s pytree structure via
     abstract ShapeDtypeStructs (no template FLOPs, no sharding template —
-    restored values are re-placed by the next jit)."""
+    restored values are re-placed by the next jit). Transient IO errors
+    retry under ``CHECKPOINT_POLICY`` (the restore is the run's whole
+    resume — be patient); structural mismatches pass straight through."""
     import orbax.checkpoint as ocp
+
+    from keystone_tpu.resilience import faults
+    from keystone_tpu.resilience.retry import CHECKPOINT_POLICY
 
     leaves, treedef = jax.tree_util.tree_flatten(template)
     abstract = [jax.ShapeDtypeStruct(x.shape, x.dtype) for x in leaves]
-    restored = mgr.restore(
-        step, args=ocp.args.StandardRestore({"leaves": abstract})
-    )["leaves"]
+
+    def _attempt():
+        faults.maybe_raise("ckpt.restore", note=str(checkpoint_dir))
+        return mgr.restore(
+            step, args=ocp.args.StandardRestore({"leaves": abstract})
+        )
+
+    restored = CHECKPOINT_POLICY.call(_attempt, label="ckpt.restore")[
+        "leaves"
+    ]
     if len(restored) != len(leaves):
         raise ValueError(
             f"{checkpoint_dir} checkpoint has {len(restored)} leaves; "
@@ -131,6 +143,30 @@ def _restore_leaves(mgr, step, template, checkpoint_dir, what: str):
             "belongs to a different run"
         )
     return jax.tree_util.tree_unflatten(treedef, restored)
+
+
+def _save_leaves(mgr, step: int, state) -> None:
+    """Save ``state``'s leaves at ``step`` and wait, under
+    ``CHECKPOINT_POLICY`` — a flaky filesystem must not kill a run at
+    exactly its survival point. The ``ckpt.save`` fault hook fires
+    before orbax is invoked, so a retried save never follows a
+    half-written attempt."""
+    import orbax.checkpoint as ocp
+
+    from keystone_tpu.resilience import faults
+    from keystone_tpu.resilience.retry import CHECKPOINT_POLICY
+
+    def _attempt():
+        faults.maybe_raise("ckpt.save", note=f"step {step}")
+        mgr.save(
+            int(step),
+            args=ocp.args.StandardSave(
+                {"leaves": jax.tree_util.tree_leaves(state)}
+            ),
+        )
+        mgr.wait_until_finished()
+
+    CHECKPOINT_POLICY.call(_attempt, label="ckpt.save")
 
 
 def _write_meta_atomic(meta_path, meta) -> None:
@@ -188,8 +224,6 @@ def resumable_fit(
     raise ``every`` to amortize when passes are cheap relative to the
     risk window (TIMIT plumbs this as ``--checkpoint-every``).
     """
-    import orbax.checkpoint as ocp
-
     if every < 1:
         raise ValueError(f"every={every}: must be >= 1")
     total = est.num_iter
@@ -211,8 +245,6 @@ def _resumable_fit_inner(
     est, data, labels, mgr, meta, meta_path, total, every, n_valid,
     checkpoint_dir,
 ):
-    import orbax.checkpoint as ocp
-
     model = None
     done = 0
     latest = mgr.latest_step()
@@ -258,13 +290,7 @@ def _resumable_fit_inner(
         chunk_est = dataclasses.replace(est, num_iter=step)
         model = chunk_est.fit(data, labels, n_valid=n_valid, init=model)
         done += step
-        mgr.save(
-            done,
-            args=ocp.args.StandardSave(
-                {"leaves": jax.tree_util.tree_leaves(model)}
-            ),
-        )
-        mgr.wait_until_finished()
+        _save_leaves(mgr, done, model)
     if model is None:  # total == 0
         model = dataclasses.replace(est, num_iter=0).fit(
             data, labels, n_valid=n_valid
@@ -332,15 +358,7 @@ class TrainCheckpointer:
         return state, int(latest)
 
     def save(self, state, step: int) -> None:
-        import orbax.checkpoint as ocp
-
-        self._mgr.save(
-            int(step),
-            args=ocp.args.StandardSave(
-                {"leaves": jax.tree_util.tree_leaves(state)}
-            ),
-        )
-        self._mgr.wait_until_finished()
+        _save_leaves(self._mgr, step, state)
 
     def close(self) -> None:
         self._mgr.close()

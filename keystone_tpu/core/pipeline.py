@@ -38,6 +38,8 @@ import jax
 
 from keystone_tpu.core.treenode import static_field, treenode
 from keystone_tpu.observe import events as _events
+from keystone_tpu.resilience import faults as _faults
+from keystone_tpu.resilience import guards as _guards
 
 _NULL_SPAN = contextlib.nullcontext()
 
@@ -217,23 +219,34 @@ class Pipeline(Transformer):
         return Pipeline(nodes=tuple(flat))
 
     def __call__(self, batch):
-        if _events.active() is None:
+        # two flag reads when everything is off (observe + output
+        # guard), zero per-node work — the hot path stays flat
+        if _events.active() is None and not _guards.output_guard_mode():
             for node in self.nodes:
                 batch = node(batch)
             return batch
         return self._call_observed(batch)
 
     def _call_observed(self, batch):
-        """Per-node event-emitting apply (active sink only). Nodes that
-        carry their own instrumentation (observe.instrument wrappers)
-        record themselves — bracketing them again would double-count."""
+        """Per-node event-emitting apply (active sink or output guard).
+        Nodes that carry their own instrumentation (observe.instrument
+        wrappers) record themselves — bracketing them again would
+        double-count. The opt-in output guard checks each node's
+        result for non-finite values (skipped under jit tracing, where
+        there is no value to check — and the sync it forces is exactly
+        why the guard is opt-in)."""
         phase = _call_phase(batch)
+        guard_on = bool(_guards.output_guard_mode()) and phase != "compile"
         for i, node in enumerate(self.nodes):
             if getattr(node, "_observe_instrumented", False):
                 batch = node(batch)
-                continue
-            with _node_span(_events.node_label(node, i), phase):
-                batch = node(batch)
+            else:
+                with _node_span(_events.node_label(node, i), phase):
+                    batch = node(batch)
+            if guard_on:
+                _guards.check_finite(
+                    _events.node_label(node, i), batch, phase
+                )
         return batch
 
     def __iter__(self):
@@ -250,6 +263,27 @@ class Pipeline(Transformer):
     def __repr__(self):
         inner = " >> ".join(type(n).__name__ for n in self.nodes)
         return f"Pipeline({inner})"
+
+
+def _fit_entry(data):
+    """Resilience hooks at a chained fit's eager entry: the
+    ``batch.nan`` site poisons a float batch, ``accel.fit`` drops the
+    "accelerator" (raises the UNAVAILABLE-shaped error a dead device
+    link produces). One global read when no faults are configured;
+    tracers pass through untouched (injection happens at dispatch, not
+    inside the XLA program)."""
+    if _faults.active() is None or is_tracing(data):
+        return data
+    data = _faults.poison("batch.nan", data)
+    _faults.maybe_drop_accelerator()
+    return data
+
+
+def _guard_feats(name: str, feats) -> None:
+    """Opt-in non-finite check on the featurized fit input (the output
+    guard's fit-path hook)."""
+    if _guards.output_guard_mode() and not is_tracing(feats):
+        _guards.check_finite(name, feats, "fit")
 
 
 class Estimator:
@@ -348,8 +382,10 @@ class ChainedEstimator(Estimator):
     est: Estimator
 
     def fit(self, data, **kw) -> Pipeline:
+        data = _fit_entry(data)
         with _node_span(_events.node_label(self.prefix), "apply"):
             feats = self.prefix(data)
+        _guard_feats(_events.node_label(self.prefix), feats)
         with _node_span(_events.node_label(self.est), "fit"):
             model = self.est.fit(feats, **kw)
         return Pipeline.of(self.prefix, model)
@@ -376,8 +412,10 @@ class ChainedLabelEstimator(LabelEstimator):
     est: LabelEstimator
 
     def fit(self, data, labels, **kw) -> Pipeline:
+        data = _fit_entry(data)
         with _node_span(_events.node_label(self.prefix), "apply"):
             feats = self.prefix(data)
+        _guard_feats(_events.node_label(self.prefix), feats)
         with _node_span(_events.node_label(self.est), "fit"):
             model = self.est.fit(feats, labels, **kw)
         return Pipeline.of(self.prefix, model)
@@ -407,7 +445,9 @@ def _fused_fit(chained, data, labels, kw):
     """The fused featurize+fit dispatch, bracketed as one "fit" node
     (the prefix and estimator are a single XLA program here, so a
     per-stage split would be fiction — the event records the fused
-    launch under the estimator's name)."""
+    launch under the estimator's name). Fault injection happens here
+    at the dispatch boundary, not inside the program."""
+    data = _fit_entry(data)
     name = _events.node_label(chained.est) + "+fused"
     with _node_span(name, "fit"):
         return _fused_fit_program(chained, data, labels, kw)

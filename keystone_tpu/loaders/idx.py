@@ -36,22 +36,34 @@ def _open(path: str):
 
 
 def load_idx(path: str) -> np.ndarray:
-    """One IDX file → ndarray with the header's shape and dtype."""
-    with _open(path) as f:
-        zero, code, ndim = struct.unpack(">HBB", f.read(4))
-        if zero != 0 or code not in _DTYPES:
-            raise ValueError(
-                f"{path}: not an IDX file (magic {zero:#x}/{code:#x})"
+    """One IDX file → ndarray with the header's shape and dtype.
+
+    Transient read errors (flaky NFS/tunnel, the ``idx.read`` fault
+    site) retry under ``IO_POLICY``; a malformed file (bad magic, short
+    payload) is a ValueError that passes straight through — corruption
+    is not transient."""
+    from keystone_tpu.resilience import faults
+    from keystone_tpu.resilience.retry import IO_POLICY
+
+    def _read() -> np.ndarray:
+        faults.maybe_raise("idx.read", note=path)
+        with _open(path) as f:
+            zero, code, ndim = struct.unpack(">HBB", f.read(4))
+            if zero != 0 or code not in _DTYPES:
+                raise ValueError(
+                    f"{path}: not an IDX file (magic {zero:#x}/{code:#x})"
+                )
+            dims = struct.unpack(f">{ndim}i", f.read(4 * ndim))
+            data = np.frombuffer(
+                f.read(), dtype=np.dtype(_DTYPES[code]).newbyteorder(">")
             )
-        dims = struct.unpack(f">{ndim}i", f.read(4 * ndim))
-        data = np.frombuffer(
-            f.read(), dtype=np.dtype(_DTYPES[code]).newbyteorder(">")
-        )
-    if data.size != int(np.prod(dims)):
-        raise ValueError(
-            f"{path}: payload {data.size} != header {dims}"
-        )
-    return data.reshape(dims).astype(_DTYPES[code])
+        if data.size != int(np.prod(dims)):
+            raise ValueError(
+                f"{path}: payload {data.size} != header {dims}"
+            )
+        return data.reshape(dims).astype(_DTYPES[code])
+
+    return IO_POLICY.call(_read, label="idx.read")
 
 
 def is_idx_path(path: str) -> bool:

@@ -40,16 +40,60 @@ def decode_image(data: bytes, target_size: int | None) -> np.ndarray:
     return np.asarray(img, np.float32)
 
 
-def _iter_tar_images(tar_path: str):
-    with tarfile.open(tar_path) as tf:
-        for member in tf:
-            if not member.isfile():
-                continue
-            name = os.path.basename(member.name)
-            if not name.lower().endswith((".jpg", ".jpeg", ".png")):
-                continue
-            data = tf.extractfile(member).read()
-            yield member.name, data
+def _iter_tar_images(tar_path: str, *, strict: bool = False):
+    """Yield ``(name, bytes)`` image entries of one tar, resiliently.
+
+    Transient open errors retry under ``IO_POLICY`` (and the
+    ``tar.read`` fault site injects them); an archive that stays
+    unreadable is SKIPPED with one warning + an
+    ``ingest_archives_skipped`` counter — one corrupt shard must not
+    abort a multi-tar ingest (the reference got this from Spark task
+    re-execution; tf.data treats ingest skip/retry the same way). A
+    read error mid-archive (truncated tar) yields the readable prefix
+    and skips the rest, counted separately. ``strict=True`` restores
+    raise-on-error for callers that want the abort."""
+    from keystone_tpu.resilience import faults, retry
+
+    def _open():
+        faults.maybe_raise("tar.read", note=tar_path)
+        return tarfile.open(tar_path)
+
+    try:
+        tf = retry.IO_POLICY.call(_open, label="tar.open")
+    except (retry.RetryExhausted, OSError, tarfile.ReadError) as e:
+        if strict:
+            raise
+        _count_archive_failure(tar_path, e, "unreadable")
+        return
+    with tf:
+        try:
+            for member in tf:
+                if not member.isfile():
+                    continue
+                name = os.path.basename(member.name)
+                if not name.lower().endswith((".jpg", ".jpeg", ".png")):
+                    continue
+                data = tf.extractfile(member).read()
+                yield member.name, data
+        except (OSError, EOFError, tarfile.ReadError) as e:
+            if strict:
+                raise
+            _count_archive_failure(tar_path, e, "truncated")
+
+
+def _count_archive_failure(tar_path: str, e: BaseException, reason: str) -> None:
+    """One warning + counter + resilience event per skipped archive."""
+    from keystone_tpu.resilience.emit import decision
+
+    _logger().warning("skipping %s tar %s: %s", reason, tar_path, e)
+    decision(
+        "archive_skipped",
+        counter="ingest_archives_skipped",
+        counter_labels={"reason": reason},
+        path=tar_path,
+        reason=reason,
+        error=repr(e),
+    )
 
 
 def load_tar_images(
@@ -66,6 +110,14 @@ def load_tar_images(
     in ``decode_batch``-sized groups so raw compressed bytes are dropped as
     soon as each group is decoded (peak host memory is pixels + one group
     of bytes, not the whole corpus's bytes).
+
+    This eager entry point is STRICT about archives: transient open
+    errors still retry, but a corrupt/unreadable tar raises rather than
+    silently shrinking the materialized dataset (a small eager load is
+    usually one archive — an empty result would fail confusingly far
+    downstream). The skip-and-continue contract belongs to the
+    streaming path (:func:`keystone_tpu.loaders.streaming.
+    iter_tar_image_batches`).
     """
 
     def try_decode(nd):
@@ -75,6 +127,7 @@ def load_tar_images(
             return decode_image(nd[1], target_size)
         except Exception as e:  # noqa: BLE001 — PIL raises various types
             _logger().warning("failed to decode %s: %s", nd[0], e)
+            _count_decode_failure("image_loaders")
             return None
 
     names: list[str] = []
@@ -92,7 +145,7 @@ def load_tar_images(
             batch = []
 
         for p in paths:
-            for item in _iter_tar_images(p):
+            for item in _iter_tar_images(p, strict=True):
                 if name_prefix is not None and not item[0].startswith(
                     name_prefix
                 ):
@@ -103,6 +156,14 @@ def load_tar_images(
         if batch:
             flush()
     return names, np.stack(imgs) if imgs else np.zeros((0, 0, 0, 3), np.float32)
+
+
+def _count_decode_failure(loader: str) -> None:
+    from keystone_tpu.observe import metrics
+
+    metrics.get_registry().counter(
+        "ingest_decode_failures", loader=loader
+    ).inc()
 
 
 def _logger():

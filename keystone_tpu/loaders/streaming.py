@@ -26,7 +26,12 @@ from typing import Callable, Iterable, Iterator
 
 import numpy as np
 
-from keystone_tpu.loaders.image_loaders import _expand, _iter_tar_images, decode_image
+from keystone_tpu.loaders.image_loaders import (
+    _count_decode_failure,
+    _expand,
+    _iter_tar_images,
+    decode_image,
+)
 
 
 def iter_tar_image_batches(
@@ -46,6 +51,12 @@ def iter_tar_image_batches(
     pixels are alive at once. ``label_of`` maps an entry name to an int
     label (entries mapping to a negative label are skipped, matching the
     eager loaders' unmapped-image drop).
+
+    Corrupt/unreadable archives do not abort the stream: transient open
+    errors retry, a dead archive is skipped with one warning and an
+    ``ingest_archives_skipped`` counter, and per-image decode failures
+    count under ``ingest_decode_failures`` (see
+    :mod:`keystone_tpu.resilience`).
     """
     import concurrent.futures
 
@@ -58,6 +69,7 @@ def iter_tar_image_batches(
             return decode_image(nd[1], target_size)
         except Exception as e:  # noqa: BLE001 — PIL raises various types
             _logger().warning("failed to decode %s: %s", nd[0], e)
+            _count_decode_failure("streaming")
             return None
 
     with concurrent.futures.ThreadPoolExecutor(workers) as ex:

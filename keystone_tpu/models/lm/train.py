@@ -142,24 +142,67 @@ def make_pp_train_step(optimizer, mesh, *, n_micro: int,
     return step
 
 
-def make_train_step(optimizer, *, logit_chunk: int = 0):
+def make_train_step(
+    optimizer, *, logit_chunk: int = 0, guarded: bool = False,
+    skip_nonfinite: bool = True,
+):
     """One buffer-donated jitted program: grads + AdamW update + loss.
     ``logit_chunk`` chunks the CE so the (B, S, V) f32 logits never
     materialize (the long-context memory/bandwidth lever — see
-    :func:`keystone_tpu.models.lm.losses.chunked_token_cross_entropy`)."""
+    :func:`keystone_tpu.models.lm.losses.chunked_token_cross_entropy`).
+
+    ``guarded=True`` returns the poison-aware variant
+    ``step(model, opt_state, tokens, poison)``: ``poison`` (scalar
+    bool) NaNs the loss *and* grads for deterministic fault injection —
+    multiplicative, so the unpoisoned path is bit-identical to itself
+    across runs. With ``skip_nonfinite=True`` (a guard mode is on) the
+    update is additionally applied only where the loss is finite (a
+    leafwise ``where`` select — with buffer donation the pre-update
+    state is unrecoverable on the host, so skip-batch MUST be decided
+    in-program); with it False an injected NaN corrupts exactly what a
+    real bad batch would. Still one XLA launch per step."""
+    if not guarded:
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def step(model, opt_state, tokens):
+            loss, grads = jax.value_and_grad(
+                functools.partial(next_token_loss, logit_chunk=logit_chunk)
+            )(model, tokens)
+            updates, opt_state = optimizer.update(
+                grads, opt_state, params=model
+            )
+            model = optax.apply_updates(model, updates)
+            return model, opt_state, loss
+
+        return step
+
+    import jax.numpy as jnp
+
+    from keystone_tpu.resilience.guards import guarded_update
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def step(model, opt_state, tokens):
-        loss, grads = jax.value_and_grad(
-            functools.partial(next_token_loss, logit_chunk=logit_chunk)
-        )(model, tokens)
-        updates, opt_state = optimizer.update(
+    def guarded_step(model, opt_state, tokens, poison):
+        def lossfn(m, t):
+            loss = next_token_loss(m, t, logit_chunk=logit_chunk)
+            # poison scales rather than adds so the backward pass NaNs
+            # too — an injected bad batch corrupts exactly what a real
+            # one would
+            return loss * jnp.where(
+                poison, jnp.float32(np.nan), jnp.float32(1.0)
+            )
+
+        loss, grads = jax.value_and_grad(lossfn)(model, tokens)
+        updates, new_opt = optimizer.update(
             grads, opt_state, params=model
         )
-        model = optax.apply_updates(model, updates)
-        return model, opt_state, loss
+        new_model = optax.apply_updates(model, updates)
+        if skip_nonfinite:
+            ok = jnp.isfinite(loss)
+            new_model = guarded_update(ok, new_model, model)
+            new_opt = guarded_update(ok, new_opt, opt_state)
+        return new_model, new_opt, loss
 
-    return step
+    return guarded_step
 
 
 def _step_batch(corpus, seed: int, i: int, batch: int, seq: int):
@@ -220,6 +263,8 @@ def train(
     schedule: str = "constant",
     grad_clip: float = 0.0,
     logit_chunk: int = 0,
+    guard=None,
+    step_timeout_s: float = 0.0,
 ):
     """Train on random windows of ``corpus`` (1-D int array). Returns
     (model, losses). Batches are dp-sharded over the mesh ``data`` axis
@@ -240,12 +285,44 @@ def train(
     dense loss up to FP reduction order, which is exactly why it IS part
     of the run identity (a resume must not silently change the low bits
     of the trajectory).
+
+    Resilience (see :mod:`keystone_tpu.resilience`):
+
+    - ``guard`` — a ``GuardConfig``, a mode string (``"skip"``/
+      ``"halt"``), or None (→ the ``KEYSTONE_GUARD`` env default).
+      ``skip`` leaves model+optimizer untouched on a non-finite-loss
+      step (decided in-program — donation-safe); ``halt`` additionally
+      stops at the next interval check and returns the last
+      checkpointed state. Guard state syncs the loss window once per
+      ``check_every`` steps, never per step.
+    - with ``checkpoint_dir`` set, SIGTERM/SIGINT checkpoint the last
+      completed step and return early, and every exit path attempts a
+      final checkpoint in ``finally`` — a clean break (signal,
+      preemption, a host-side exception between steps) loses at most
+      the in-flight step. A hard device failure can poison the live
+      buffers mid-step; the rescue save then fails (logged, never
+      masking the original error) and the run falls back to the last
+      periodic checkpoint.
+    - ``step_timeout_s`` (or ``KEYSTONE_STEP_TIMEOUT_S``) arms a
+      watchdog that logs thread stacks when a step stops completing.
+    - fault sites ``train.nan`` / ``train.preempt`` / ``train.sigterm``
+      (``KEYSTONE_FAULTS``, keyed by step index so schedules survive
+      resume) inject each failure deterministically.
     """
     import hashlib
+    import os as _os
+    import signal as _signal
+    import threading as _threading
 
     import jax.numpy as jnp
 
     from keystone_tpu.parallel.mesh import data_sharding
+    from keystone_tpu.resilience import faults as _faults
+    from keystone_tpu.resilience.guards import (
+        LossGuard,
+        NumericalHealthError,
+        resolve_guard,
+    )
 
     if len(corpus) < seq + 2:
         raise ValueError(
@@ -259,11 +336,24 @@ def train(
             "inference-only) — gradients through the rounding would be "
             "silently zero; train the float model and re-quantize"
         )
+    guard_cfg = resolve_guard(guard)
+    plan = _faults.active()
+    # the guarded step is a DIFFERENT compiled program (poison arg, and
+    # the update select only under an actual guard mode — an injected
+    # NaN with no guard must corrupt like the real thing); build it
+    # only when asked, so the default hot loop is untouched
+    skip_nonfinite = guard_cfg.mode != "off"
+    guarded = skip_nonfinite or (
+        plan is not None and plan.has_site("train.nan")
+    )
     optimizer = make_optimizer(
         lr, steps=steps, schedule=schedule, grad_clip=grad_clip
     )
     opt_state = optimizer.init(model)
-    step = make_train_step(optimizer, logit_chunk=logit_chunk)
+    step = make_train_step(
+        optimizer, logit_chunk=logit_chunk, guarded=guarded,
+        skip_nonfinite=skip_nonfinite,
+    )
     losses = []
     sharding = None
     if (
@@ -301,6 +391,14 @@ def train(
                 "schedule": schedule,
                 "grad_clip": grad_clip,
                 "logit_chunk": logit_chunk,
+                # the guarded step is a different program; like
+                # logit_chunk it may move low bits, so it IS run
+                # identity. False = plain step, "inject" = poison arg
+                # only, "skip" = poison + non-finite update select
+                "guarded": (
+                    False if not guarded
+                    else ("skip" if skip_nonfinite else "inject")
+                ),
                 "num_heads": model.num_heads,
                 # normalized (kv_heads, never the 0 alias) so MHA spelled
                 # either way compares equal
@@ -337,12 +435,53 @@ def train(
                 "grad_clip": 0.0,
                 # pre-chunked-CE checkpoints were all dense
                 "logit_chunk": 0,
+                # pre-resilience checkpoints all ran the plain step
+                "guarded": False,
                 # pre-policy checkpoints always full-rematerialized
                 "remat_policy": "full",
                 # pre-GQA checkpoints were all MHA
                 "num_kv_heads": model.num_heads,
             },
         )
+    if step_timeout_s <= 0:
+        step_timeout_s = float(
+            _os.environ.get("KEYSTONE_STEP_TIMEOUT_S", "0") or 0
+        )
+    loss_guard = LossGuard(guard_cfg)
+    # first signal → flag only; the loop checks it each step and the
+    # finally path checkpoints, so SIGTERM/SIGINT lose at most the
+    # in-flight step. A SECOND signal means the loop isn't getting back
+    # to its check (a wedged step): restore the previous dispositions
+    # and re-deliver so repeat Ctrl-C / SIGTERM actually escalates.
+    stop_signal: dict = {"sig": None}
+    prev_handlers: dict = {}
+    if ckpt is not None and _threading.current_thread() is _threading.main_thread():
+        def _on_signal(signum, frame):
+            if stop_signal["sig"] is not None:
+                for s, h in prev_handlers.items():
+                    _signal.signal(s, h)
+                prev = prev_handlers.get(signum)
+                if callable(prev):
+                    prev(signum, frame)
+                else:
+                    _signal.raise_signal(signum)
+                return
+            stop_signal["sig"] = signum
+
+        for s in (_signal.SIGTERM, _signal.SIGINT):
+            prev_handlers[s] = _signal.signal(s, _on_signal)
+
+    dog = None
+    if step_timeout_s > 0:
+        from keystone_tpu.resilience.watchdog import Watchdog
+
+        # created here, STARTED after the first step completes: the
+        # first iteration includes jit compilation, which would
+        # otherwise guarantee a spurious stall report on every run
+        dog = Watchdog(step_timeout_s, label="lm_train")
+
+    completed = last_saved = 0
+    halted = False
     try:
         if ckpt is not None:
             (model, opt_state), start = ckpt.restore((model, opt_state))
@@ -352,24 +491,107 @@ def train(
                     f"this run is only {steps} steps — refusing to return "
                     "an over-trained model; point at a fresh directory"
                 )
+        completed = last_saved = start
         for i in range(start, steps):
             toks = jnp.asarray(_step_batch(corpus, seed, i, batch, seq))
             if sharding is not None:
                 toks = jax.device_put(toks, sharding)
-            model, opt_state, loss = step(model, opt_state, toks)
+            if guarded:
+                poison = _faults.fire("train.nan", key=i)
+                model, opt_state, loss = step(
+                    model, opt_state, toks, poison
+                )
+            else:
+                model, opt_state, loss = step(model, opt_state, toks)
             # keep the loss on device: a float() here would block a host
             # round-trip into every step and serialize the dispatch queue
             losses.append(loss)
+            completed = i + 1
+            # one host sync per check interval, not per step
+            loss_guard.note(i, loss)
+            if dog is not None:
+                dog.pet() if dog.running else dog.start()
             if log_every and (i + 1) % log_every == 0:
                 logger.info("step %d loss %.4f", i + 1, float(loss))
             if ckpt is not None and (
                 (i + 1) % every == 0 or (i + 1) == steps
             ):
                 ckpt.save((model, opt_state), i + 1)
+                last_saved = i + 1
+            if _faults.fire("train.sigterm", key=i):
+                if prev_handlers:
+                    # a REAL signal to this process: exercises the
+                    # handler path end to end, not a shortcut around it
+                    _signal.raise_signal(_signal.SIGTERM)
+                else:
+                    # no handler installed (no checkpoint_dir, or not
+                    # the main thread): a real SIGTERM would just kill
+                    # the process — that tests nothing about us
+                    logger.warning(
+                        "train.sigterm fault fired at step %d but no "
+                        "handler is installed; ignoring", i
+                    )
+            if stop_signal["sig"] is not None:
+                logger.warning(
+                    "signal %d at step %d: writing final checkpoint and "
+                    "stopping early",
+                    stop_signal["sig"],
+                    i + 1,
+                )
+                _emit_resilience(
+                    "signal_stop", signum=stop_signal["sig"], step=i + 1
+                )
+                break
+            _faults.maybe_preempt(key=i)
+        loss_guard.flush()
+    except NumericalHealthError as e:
+        # halt-with-last-good-checkpoint: training is unhealthy; return
+        # the last checkpointed state rather than the post-spike one
+        halted = True
+        logger.warning("training halted by health guard: %s", e)
+        _emit_resilience("guard_halt", step=completed, error=repr(e))
+        if ckpt is None:
+            raise
+        (model, opt_state), restored = ckpt.restore((model, opt_state))
+        if restored == 0:
+            # nothing was ever checkpointed (saves start at step >= 1):
+            # there is no "last good" state to return — restore() just
+            # handed back the live post-spike template, so propagate
+            raise
+        losses = losses[: max(restored - start, 0)]
     finally:
-        if ckpt is not None:
-            ckpt.close()
+        try:
+            if ckpt is not None and completed > last_saved and not halted:
+                # preemption / signal / crash path: the loop's periodic
+                # save didn't cover the last completed step — write it
+                # now so at most the in-flight step is lost
+                ckpt.save((model, opt_state), completed)
+                _emit_resilience("final_checkpoint", step=completed)
+        except Exception:  # noqa: BLE001 — a failed rescue save must
+            # not mask the original exception (the preemption itself)
+            logger.exception(
+                "final checkpoint save at step %d failed", completed
+            )
+        finally:
+            if ckpt is not None:
+                ckpt.close()
+            if dog is not None:
+                dog.stop()
+            for s, h in prev_handlers.items():
+                _signal.signal(s, h)
+    if loss_guard.skipped:
+        logger.warning(
+            "guard skipped %d non-finite step(s): %s",
+            len(loss_guard.skipped),
+            loss_guard.skipped,
+        )
     return model, [float(l) for l in losses]
+
+
+def _emit_resilience(action: str, **fields) -> None:
+    from keystone_tpu.resilience.emit import decision
+
+    decision(action, **fields)
 
 
 def synthetic_corpus(n: int, vocab: int, seed: int = 0) -> np.ndarray:

@@ -17,6 +17,8 @@ that executes the pipeline, which is what the launcher flag does):
 
 from __future__ import annotations
 
+import os
+
 import jax
 import numpy as np
 
@@ -24,32 +26,109 @@ from keystone_tpu.core.logging import get_logger
 
 logger = get_logger("keystone_tpu.parallel.multihost")
 
+#: env override for :func:`initialize`'s ``init_timeout_s``.
+ENV_INIT_TIMEOUT = "KEYSTONE_INIT_TIMEOUT_S"
+_DEFAULT_INIT_TIMEOUT_S = 300.0
+
 
 def initialize(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
     process_id: int | None = None,
+    init_timeout_s: float | None = None,
 ) -> None:
     """Join this process into the multi-host runtime.
 
     With TPU VMs all arguments are discovered from the environment
     (``jax.distributed.initialize()`` no-arg form); explicit values support
     CPU/GPU test rigs.
+
+    ``init_timeout_s`` (default ``KEYSTONE_INIT_TIMEOUT_S``, else 300)
+    bounds the join: a missing peer or dead coordinator fails in
+    seconds with the coordinator address in the message instead of
+    hanging the launch forever — on a preempted slice rejoin, the
+    hang IS the failure mode (see tunnel_watch.log). Non-coordinator
+    processes preflight the coordinator's TCP port under this timeout
+    (a clean, catchable RuntimeError names the address); the in-barrier
+    wait is then bounded by jax's own ``initialization_timeout``, whose
+    expiry the jax client escalates to a fatal process exit — bounded
+    either way, never a silent hang.
     """
-    kwargs = {}
+    if init_timeout_s is None:
+        init_timeout_s = float(
+            os.environ.get(ENV_INIT_TIMEOUT, "") or _DEFAULT_INIT_TIMEOUT_S
+        )
+    kwargs = {"initialization_timeout": max(int(init_timeout_s), 1)}
     if coordinator_address is not None:
-        kwargs = dict(
+        kwargs.update(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
         )
-    jax.distributed.initialize(**kwargs)
+        if process_id not in (None, 0):
+            _preflight_coordinator(
+                coordinator_address, init_timeout_s, process_id
+            )
+    try:
+        jax.distributed.initialize(**kwargs)
+    except Exception as e:  # noqa: BLE001 — re-raised with diagnosis
+        addr = (
+            coordinator_address
+            or os.environ.get("JAX_COORDINATOR_ADDRESS")
+            or "<auto-discovered>"
+        )
+        raise RuntimeError(
+            f"multihost initialize failed (timeout {init_timeout_s:.0f}s, "
+            f"coordinator {addr}, process_id={process_id}, "
+            f"num_processes={num_processes}): every host must run the "
+            "same command and reach the coordinator; check that no "
+            f"worker died or was preempted. Underlying error: {e!r}"
+        ) from e
     logger.info(
         "multihost: process %d/%d, %d local / %d global devices",
         jax.process_index(),
         jax.process_count(),
         jax.local_device_count(),
         jax.device_count(),
+    )
+
+
+def _preflight_coordinator(
+    addr: str, timeout_s: float, process_id: int
+) -> None:
+    """Bounded poll of the coordinator's TCP port before handing the
+    process to ``jax.distributed.initialize``. The jax client reacts to
+    its own init deadline with a FATAL process exit (no Python
+    exception to catch), so the reachable-at-all check must happen out
+    here where a dead coordinator can fail cleanly, fast, and with the
+    address in the message."""
+    import socket
+    import time
+
+    host, _, port = addr.rpartition(":")
+    host = host.strip("[]")  # bracketed IPv6
+    if not host or not port.isdigit():
+        # unparseable address: let jax.distributed do the validating —
+        # the preflight exists to diagnose reachability, not syntax
+        return
+    deadline = time.monotonic() + timeout_s
+    last: Exception | None = None
+    while True:
+        # at least ONE attempt even when timeout_s is 0/tiny — a live
+        # coordinator must never be reported unreachable unprobed
+        try:
+            with socket.create_connection((host, int(port)), timeout=1.0):
+                return
+        except OSError as e:
+            last = e
+        if time.monotonic() >= deadline:
+            break
+        time.sleep(0.2)
+    raise RuntimeError(
+        f"multihost initialize: coordinator {addr} unreachable after "
+        f"{timeout_s:.0f}s (process_id={process_id}); the coordinator "
+        "(process 0) must be running and reachable before workers join. "
+        f"Last error: {last!r}"
     )
 
 

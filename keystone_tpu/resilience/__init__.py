@@ -1,0 +1,50 @@
+"""Resilience subsystem: deterministic fault injection, retry/backoff,
+numerical health guards, and hang watchdogs.
+
+KeystoneML inherited fault tolerance from Spark (lineage recompute,
+straggler re-execution); the TPU rebuild is one process, so surviving
+the faults preemptible TPUs and the device tunnel actually produce is
+an explicit subsystem here (ROADMAP north star: heavy production
+traffic). The degrade-don't-crash default follows tf.data's treatment
+of ingest-level skip/retry as a framework concern:
+
+- :mod:`.faults` — env-gated (``KEYSTONE_FAULTS``) seed-deterministic
+  fault injection; every CI failure replays exactly.
+- :mod:`.retry` — :class:`~keystone_tpu.resilience.retry.RetryPolicy`
+  (exponential backoff + jitter + deadline + transient classifier),
+  applied to tar/idx ingestion, checkpoint IO, and the bench probe.
+- :mod:`.guards` — non-finite/spike loss guards for the LM train loop
+  (donation-safe in-program skip, one host sync per interval) and the
+  opt-in pipeline output guard (``KEYSTONE_GUARD_OUTPUTS``).
+- :mod:`.watchdog` — step-time stall detection with thread-stack
+  diagnostics.
+
+All four are stdlib-light at import (jax loads lazily inside
+functions) so the loaders and core pipeline can depend on them without
+widening their import graph. Every retry/skip/guard/watchdog decision
+emits through :mod:`keystone_tpu.observe` (events tagged
+``phase="resilience"`` + metrics counters), so a run report shows
+exactly what was survived.
+"""
+
+from __future__ import annotations
+
+from keystone_tpu.resilience import faults, guards, retry, watchdog  # noqa: F401
+from keystone_tpu.resilience.faults import (  # noqa: F401
+    AcceleratorDrop,
+    InjectedFault,
+    SimulatedPreemption,
+)
+from keystone_tpu.resilience.guards import (  # noqa: F401
+    GuardConfig,
+    LossGuard,
+    NumericalHealthError,
+)
+from keystone_tpu.resilience.retry import (  # noqa: F401
+    CHECKPOINT_POLICY,
+    IO_POLICY,
+    RetryExhausted,
+    RetryPolicy,
+    is_transient,
+)
+from keystone_tpu.resilience.watchdog import Watchdog  # noqa: F401

@@ -1,0 +1,35 @@
+"""The one home of the resilience observe-emission schema.
+
+Every survived decision — fault fired, retry, guard verdict, skipped
+archive, watchdog stall, rescue checkpoint — lands in the run record
+the same way: one metrics counter bump plus one structured event
+(``event: "resilience"``, ``phase: "resilience"``, an ``action`` and
+free-form detail fields). Emitters across the package call
+:func:`decision` so the schema README documents lives in exactly one
+place.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def decision(
+    action: str,
+    *,
+    counter: str | None = None,
+    counter_labels: dict[str, Any] | None = None,
+    **fields: Any,
+) -> None:
+    """Record one resilience decision: bump ``counter`` (labeled) when
+    given, and emit a ``resilience`` event when a sink is active — one
+    global read when it isn't."""
+    from keystone_tpu.observe import events, metrics
+
+    if counter:
+        metrics.get_registry().counter(
+            counter, **(counter_labels or {})
+        ).inc()
+    log = events.active()
+    if log is not None:
+        log.emit("resilience", phase="resilience", action=action, **fields)

@@ -1,0 +1,243 @@
+"""Numerical health guards: non-finite/spike detection for training
+loops and an opt-in output guard for pipeline apply/fit.
+
+Two layers, split by where the decision must live:
+
+- **In-program skip** — with buffer donation the pre-update state is
+  gone by the time the host could inspect the loss, so "skip this
+  batch" must be decided inside the jitted step:
+  :func:`guarded_update` selects update-vs-identity on loss finiteness
+  with ``jnp.where`` (no ``cond`` — both branches are one fused select,
+  donation-safe, no extra dispatch).
+- **Host-side interval check** — :class:`LossGuard` accumulates the
+  on-device loss scalars the loop already keeps and forces ONE
+  device→host sync per ``check_every`` steps, recording skips as
+  events/metrics and escalating per the configured mode (``halt``
+  restores the last good checkpoint at the call site). Loss-spike
+  detection (vs a running EMA) lives here too: a spike is detected
+  after its update applied, so it can halt or report, never skip.
+
+The pipeline output guard is env-gated (``KEYSTONE_GUARD_OUTPUTS``:
+``warn`` or ``raise``; unset = one global read, zero overhead). It
+forces a device sync per checked node — that cost is exactly why it is
+opt-in.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Any
+
+
+class NumericalHealthError(RuntimeError):
+    """Training or pipeline output failed a numerical health check."""
+
+
+@dataclasses.dataclass(frozen=True)
+class GuardConfig:
+    """Train-loop guard policy.
+
+    - ``mode``: ``"off"`` (no guard, zero overhead), ``"skip"``
+      (non-finite-loss steps leave model/optimizer untouched),
+      ``"halt"`` (raise :class:`NumericalHealthError` at the next
+      interval check; the train loop answers by restoring the last
+      good checkpoint).
+    - ``check_every``: steps between host syncs of the loss window.
+    - ``spike_factor``: ``> 0`` flags ``loss > spike_factor * EMA`` as
+      unhealthy (halt mode only — a spike is seen post-update).
+    """
+
+    mode: str = "off"
+    check_every: int = 10
+    spike_factor: float = 0.0
+
+    def __post_init__(self):
+        if self.mode not in ("off", "skip", "halt"):
+            raise ValueError(
+                f"guard mode {self.mode!r}: expected off|skip|halt"
+            )
+        if self.check_every < 1:
+            raise ValueError(f"check_every={self.check_every}: must be >= 1")
+
+
+def resolve_guard(guard: "GuardConfig | str | None") -> GuardConfig:
+    """Accept a config, a mode string, or None (→ env default).
+
+    ``KEYSTONE_GUARD`` supplies the default mode (``skip``/``halt``)
+    when the caller passes nothing — the degrade-don't-crash default is
+    opt-in per run, not imposed."""
+    if isinstance(guard, GuardConfig):
+        return guard
+    if isinstance(guard, str) and guard:
+        return GuardConfig(mode=guard)
+    env = os.environ.get("KEYSTONE_GUARD", "")
+    return GuardConfig(mode=env) if env else GuardConfig()
+
+
+def guarded_update(ok, new_state, old_state):
+    """Select ``new_state`` where ``ok`` (a scalar bool tracer) else
+    ``old_state``, leafwise — the donation-safe in-program skip."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(ok, n, o), new_state, old_state
+    )
+
+
+class LossGuard:
+    """Interval checker over the loop's on-device loss scalars.
+
+    ``note(step, loss)`` buffers; every ``check_every`` notes (and at
+    ``flush``) the buffered scalars are forced with ONE host transfer
+    and checked. Verdicts: non-finite → recorded skip (``skip`` mode)
+    or :class:`NumericalHealthError` (``halt``); spike vs EMA → error
+    in ``halt`` mode, event-only otherwise.
+    """
+
+    def __init__(self, config: GuardConfig):
+        self.config = config
+        self.skipped: list[int] = []
+        self._pending: list[tuple[int, Any]] = []
+        self._ema: float | None = None
+
+    def note(self, step: int, loss) -> None:
+        if self.config.mode == "off":
+            return
+        self._pending.append((step, loss))
+        if len(self._pending) >= self.config.check_every:
+            self._check()
+
+    def flush(self) -> None:
+        if self._pending:
+            self._check()
+
+    def _check(self) -> None:
+        import numpy as np
+
+        pending, self._pending = self._pending, []
+        # ONE device→host sync for the whole window
+        vals = np.asarray([np.asarray(l) for _, l in pending], np.float64)
+        for (step, _), val in zip(pending, vals):
+            if not np.isfinite(val):
+                self.skipped.append(step)
+                self._observe("guard_skip", step, val)
+                if self.config.mode == "halt":
+                    raise NumericalHealthError(
+                        f"non-finite loss {val} at step {step}"
+                    )
+                continue
+            if (
+                self.config.spike_factor > 0.0
+                and self._ema is not None
+                and val > self.config.spike_factor * self._ema
+            ):
+                self._observe("guard_spike", step, val)
+                if self.config.mode == "halt":
+                    raise NumericalHealthError(
+                        f"loss spike at step {step}: {val:.4g} > "
+                        f"{self.config.spike_factor:g} x EMA {self._ema:.4g}"
+                    )
+            self._ema = (
+                val if self._ema is None else 0.9 * self._ema + 0.1 * val
+            )
+
+    def _observe(self, action: str, step: int, val: float) -> None:
+        from keystone_tpu.resilience.emit import decision
+
+        decision(
+            action,
+            counter="guard_events",
+            counter_labels={"action": action},
+            step=step,
+            loss=float(val),
+            mode=self.config.mode,
+        )
+
+
+# ---- pipeline output guard (env-gated, one global read when off) ----
+
+ENV_OUTPUT_GUARD = "KEYSTONE_GUARD_OUTPUTS"
+
+_UNINIT: Any = object()
+_output_mode: Any = _UNINIT
+_state_lock = threading.Lock()
+
+
+def output_guard_mode() -> str:
+    """The pipeline output-guard mode: ``""`` (off), ``"warn"``, or
+    ``"raise"``. One module-global read once initialized."""
+    global _output_mode
+    mode = _output_mode
+    if mode is _UNINIT:
+        with _state_lock:
+            if _output_mode is _UNINIT:
+                raw = os.environ.get(ENV_OUTPUT_GUARD, "").strip().lower()
+                resolved = {
+                    "": "", "0": "", "off": "", "false": "",
+                    "1": "warn", "true": "warn",
+                    "warn": "warn", "raise": "raise",
+                }.get(raw)
+                if resolved is None:
+                    # fail fast on a typo'd mode (e.g. "halt", which
+                    # belongs to KEYSTONE_GUARD) — silently warning
+                    # when the user asked to stop is the worst outcome
+                    raise ValueError(
+                        f"{ENV_OUTPUT_GUARD}={raw!r}: expected "
+                        "warn|raise (1/true = warn; empty/0/off = off)"
+                    )
+                _output_mode = resolved
+            mode = _output_mode
+    return mode
+
+
+def set_output_guard(mode: str | None) -> None:
+    """Programmatic override (tests); ``None`` re-arms env detection."""
+    global _output_mode
+    with _state_lock:
+        _output_mode = _UNINIT if mode is None else mode
+
+
+def check_finite(label: str, value, phase: str = "apply") -> bool:
+    """Check every float leaf of ``value`` for non-finite entries per
+    the active output-guard mode. Returns True when healthy. Forces a
+    device sync — only called when the guard is on."""
+    mode = output_guard_mode()
+    if not mode:
+        return True
+    import jax
+    import numpy as np
+
+    bad = 0
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(value):
+        arr = np.asarray(leaf)
+        if not np.issubdtype(arr.dtype, np.floating):
+            continue
+        total += arr.size
+        bad += int(np.count_nonzero(~np.isfinite(arr)))
+    if bad == 0:
+        return True
+    from keystone_tpu.core.logging import get_logger
+    from keystone_tpu.resilience.emit import decision
+
+    decision(
+        "nonfinite_output",
+        counter="guard_events",
+        counter_labels={"action": "nonfinite_output"},
+        node=label,
+        node_phase=phase,
+        bad=bad,
+        total=total,
+        mode=mode,
+    )
+    msg = (
+        f"node {label!r} ({phase}) produced {bad}/{total} non-finite "
+        "values"
+    )
+    if mode == "raise":
+        raise NumericalHealthError(msg)
+    get_logger("keystone_tpu.resilience").warning("%s", msg)
+    return False
