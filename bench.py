@@ -203,6 +203,62 @@ def _mnist_per_node_breakdown(fitted, x) -> dict:
         return collect(log)
 
 
+def _mnist_planner_record(fitted, x, y, n) -> dict:
+    """Planned-vs-naive record for the fitted MNIST pipeline: the
+    cost-based planner's executor against the plain eager apply on the
+    same probe, plus a shared-prefix fit (two solvers riding ONE
+    featurizer bank) whose metrics-counter delta shows the planner
+    eliminating a redundant featurization pass. Decisions ride along so
+    the perf trajectory records WHAT the planner chose, not just the
+    delta."""
+    import jax
+
+    from keystone_tpu import plan as plan_mod
+    from keystone_tpu.core.pipeline import ChainedLabelEstimator, Pipeline
+    from keystone_tpu.observe import metrics as observe_metrics
+    from keystone_tpu.ops.linear import BlockLeastSquaresEstimator
+    from keystone_tpu.ops.util import MaxClassifier
+
+    pipe = Pipeline.of(*fitted.nodes, MaxClassifier())
+    probe = x[:2048]
+    naive_s = _timed(lambda: pipe(probe), iters=4)
+    plan = plan_mod.plan_pipeline(
+        pipe, sample=probe[:256], n_rows=probe.shape[0]
+    )
+    planned_s = _timed(lambda: plan.execute(probe), iters=4)
+
+    bank = fitted.nodes[0]
+    chains = [
+        ChainedLabelEstimator(
+            prefix=bank,
+            est=BlockLeastSquaresEstimator(
+                block_size=BLOCK_SIZE, num_iter=1, lam=lam
+            ),
+        )
+        for lam in (LAM, 10 * LAM)
+    ]
+    reg = observe_metrics.get_registry()
+    saved_before = reg.snapshot().get("plan_featurize_passes_saved", 0)
+    t0 = time.perf_counter()
+    jax.block_until_ready(
+        [f[-1] for f in plan_mod.fit_shared(chains, x, y, n_valid=n)]
+    )
+    shared_fit_s = time.perf_counter() - t0
+    saved = reg.snapshot().get("plan_featurize_passes_saved", 0) - saved_before
+    return {
+        "naive_apply_ms": round(naive_s * 1e3, 2),
+        "planned_apply_ms": round(planned_s * 1e3, 2),
+        "planned_vs_naive": round(naive_s / planned_s, 3),
+        "decisions": plan.decisions,
+        "chunk_size": plan.chunk_size,
+        "shared_prefix_fit": {
+            "branches": len(chains),
+            "featurize_passes_saved": saved,
+            "fit_s": round(shared_fit_s, 3),
+        },
+    }
+
+
 def bench_mnist(labels: np.ndarray, data: np.ndarray) -> dict:
     import jax
 
@@ -241,6 +297,10 @@ def bench_mnist(labels: np.ndarray, data: np.ndarray) -> dict:
     except Exception as e:  # noqa: BLE001 — observability must not cost
         # the bench its headline number
         per_node = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+    try:
+        planner = _mnist_planner_record(fitted_box["pipe"], x, y, n)
+    except Exception as e:  # noqa: BLE001 — same rule for the planner
+        planner = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
     d = NUM_FFTS * 512  # total feature width
     # solver-phase FLOPs: Gram N*d^2 + AtB N*d*10, Cholesky d^3/3 + refine
     flops = 2 * n * d * d + 2 * n * d * 10 + d**3 / 3
@@ -261,6 +321,7 @@ def bench_mnist(labels: np.ndarray, data: np.ndarray) -> dict:
         / 1e12
         / len(jax.devices()),
         "per_node": per_node,
+        "planner": planner,
     }
 
 
@@ -1023,6 +1084,11 @@ def main() -> None:
     # per-node operator breakdown (observe subsystem): wall time per
     # pipeline node plus compiler-modeled FLOPs/bytes when available
     result["mnist_per_node"] = mnist.get("per_node", {})
+    # planned-vs-naive execution of the same pipeline (plan subsystem):
+    # the planner's decisions + measured delta + the shared-prefix fit's
+    # eliminated featurization pass, so the perf trajectory captures
+    # planner wins alongside raw throughput
+    result["mnist_planner"] = mnist.get("planner", {})
     if "vs_native_host" in sift:
         result["sift_vs_native_host"] = round(sift["vs_native_host"], 2)
     if workload_errors:

@@ -55,6 +55,7 @@ PIPELINES = {
 COMMANDS = {
     "observe": "keystone_tpu.observe.report",
     "faults": "keystone_tpu.resilience.faults",
+    "plan": "keystone_tpu.plan.cli",
 }
 
 
@@ -96,7 +97,8 @@ def main(argv: list[str] | None = None) -> None:
             f" jax.distributed runtime before dispatch — run the same command"
             f" on every host; --observe DIR writes a structured per-node\n"
             f" event log there, rendered by `observe <dir>`; `faults --list`\n"
-            f" prints the KEYSTONE_FAULTS injection sites)"
+            f" prints the KEYSTONE_FAULTS injection sites; `plan <model>`\n"
+            f" prints the cost-based planner's chosen plan without executing)"
         )
     if argv[0] in COMMANDS:
         import importlib

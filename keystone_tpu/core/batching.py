@@ -6,14 +6,41 @@ whole dataset can't be materialized through it at once. ``apply_in_chunks``
 streams fixed-size chunks through a single compiled program (last chunk
 zero-padded so every call hits the same executable) and reassembles the
 output on the host or device.
+
+Shared with :func:`keystone_tpu.loaders.streaming.featurize_stream`:
+:func:`pad_to_chunk` (one home of the pad-to-static-shape rule) and the
+bounded-inflight deque drain — up to ``inflight`` chunk results stay
+un-forced so the host keeps dispatching while the device computes, but
+never more, so device/host residency stays a small constant instead of
+the whole output piling up un-forced behind an async dispatch queue.
 """
 
 from __future__ import annotations
 
+from collections import deque
 from typing import Callable
 
 import jax
 import numpy as np
+
+
+def pad_to_chunk(chunk, chunk_size: int) -> tuple:
+    """Zero-pad ``chunk`` along axis 0 to exactly ``chunk_size`` rows.
+
+    Returns ``(padded, valid)`` where ``valid`` is the real row count —
+    the caller drops the pad rows from the output. One static shape means
+    ONE compiled executable serves every chunk of a ragged stream.
+    """
+    valid = chunk.shape[0]
+    if valid == chunk_size:
+        return chunk, valid
+    pad = [(0, chunk_size - valid)] + [(0, 0)] * (chunk.ndim - 1)
+    padded = (
+        np.pad(chunk, pad)
+        if isinstance(chunk, np.ndarray)
+        else jax.numpy.pad(chunk, pad)
+    )
+    return padded, valid
 
 
 def apply_in_chunks(
@@ -22,28 +49,40 @@ def apply_in_chunks(
     chunk_size: int,
     *,
     to_host: bool = False,
+    inflight: int = 2,
 ):
     """Apply ``fn`` (ideally jitted) to ``data`` in fixed-size chunks along
     axis 0. The last chunk is zero-padded to ``chunk_size`` (one executable)
-    and its padding rows are dropped from the result."""
+    and its padding rows are dropped from the result.
+
+    ``inflight`` bounds un-forced chunk results (same backpressure as
+    ``featurize_stream``): once more than that many are pending, the
+    oldest is forced — to the host when ``to_host``, else just completed
+    on device — before the next chunk dispatches. ``inflight=0`` restores
+    the fully synchronous round-trip.
+    """
     n = data.shape[0]
     if n <= chunk_size:
         out = fn(data)
         return np.asarray(out) if to_host else out
     outs = []
+    pending: deque = deque()  # (result, valid rows)
+
+    def force(item):
+        out, valid = item
+        if to_host:
+            return np.asarray(out)[:valid]
+        return jax.block_until_ready(out)[:valid]
+
+    def drain(limit: int):
+        while len(pending) > limit:
+            outs.append(force(pending.popleft()))
+
     for start in range(0, n, chunk_size):
-        chunk = data[start : start + chunk_size]
-        valid = chunk.shape[0]
-        if valid < chunk_size:
-            pad = [(0, chunk_size - valid)] + [(0, 0)] * (chunk.ndim - 1)
-            chunk = (
-                np.pad(chunk, pad)
-                if isinstance(chunk, np.ndarray)
-                else jax.numpy.pad(chunk, pad)
-            )
-        out = fn(chunk)
-        out = out[:valid]
-        outs.append(np.asarray(out) if to_host else out)
+        chunk, valid = pad_to_chunk(data[start : start + chunk_size], chunk_size)
+        pending.append((fn(chunk), valid))
+        drain(max(inflight, 0))
+    drain(0)
     if to_host:
         return np.concatenate(outs, axis=0)
     import jax.numpy as jnp

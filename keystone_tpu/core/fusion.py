@@ -7,9 +7,15 @@ inspectable plan, so a rewrite pass is natural: :func:`optimize` walks the
 chain and replaces adjacent node groups with fused equivalents whose
 intermediate maps stay in VMEM instead of round-tripping HBM.
 
+Rewrite rules are registered with the planner's pass registry
+(:mod:`keystone_tpu.plan.passes`) — this module holds the rules that
+belong to the image-node library, and both :func:`optimize` and the
+cost-based planner (:mod:`keystone_tpu.plan`) apply every registered
+rule, so a new rule written anywhere shows up in both paths.
+
 Current rewrite rules:
 
-- ``Convolver >> SymmetricRectifier >> Pooler``  →
+- ``conv_rectify_pool``: ``Convolver >> SymmetricRectifier >> Pooler`` →
   :class:`~keystone_tpu.ops.images.FusedConvRectifyPool`, whose default
   impl pools each rectifier half *before* the channel concat so the
   (N, oh, ow, 2F) rectified map never materializes in HBM (pooling is
@@ -29,8 +35,10 @@ from __future__ import annotations
 from keystone_tpu.core.pipeline import Pipeline, Transformer
 from keystone_tpu.observe import events as _events
 from keystone_tpu.observe import metrics as _metrics
+from keystone_tpu.plan import passes as _passes
 
 
+@_passes.rewrite_rule("conv_rectify_pool", window=3)
 def _try_fuse_conv_chain(a, b, c):
     from keystone_tpu.ops.images import (
         Convolver,
@@ -79,38 +87,26 @@ def optimize(pipe: Transformer) -> Transformer:
     """
     if not isinstance(pipe, Pipeline):
         return pipe
-    nodes = list(pipe.nodes)
-    out: list[Transformer] = []
-    i = 0
-    rewrites = 0
-    while i < len(nodes):
-        fused = (
-            _try_fuse_conv_chain(nodes[i], nodes[i + 1], nodes[i + 2])
-            if i + 2 < len(nodes)
-            else None
-        )
-        if fused is not None:
-            out.append(fused)
-            i += 3
-            rewrites += 1
-        else:
-            out.append(nodes[i])
-            i += 1
-    if not rewrites:
+    out, decisions = _passes.rewrite_nodes(pipe.nodes)
+    if not decisions:
         return pipe
     # optimizer decisions are observable: count rewrites in the metrics
     # registry and record the plan change in the event log so a cost
     # model (or a human) can see WHAT the pass did to a given run
-    _metrics.get_registry().counter(
-        "fusion_rewrites", rule="conv_rectify_pool"
-    ).inc(rewrites)
+    by_rule: dict[str, int] = {}
+    for d in decisions:
+        by_rule[d["rule"]] = by_rule.get(d["rule"], 0) + 1
     log = _events.active()
-    if log is not None:
-        log.emit(
-            "optimize",
-            rule="conv_rectify_pool",
-            rewrites=rewrites,
-            nodes_before=len(nodes),
-            nodes_after=len(out),
-        )
+    for rule, rewrites in by_rule.items():
+        _metrics.get_registry().counter(
+            "fusion_rewrites", rule=rule
+        ).inc(rewrites)
+        if log is not None:
+            log.emit(
+                "optimize",
+                rule=rule,
+                rewrites=rewrites,
+                nodes_before=len(pipe.nodes),
+                nodes_after=len(out),
+            )
     return Pipeline(nodes=tuple(out))

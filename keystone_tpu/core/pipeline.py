@@ -69,6 +69,13 @@ def _call_phase(batch) -> str:
     return "compile" if is_tracing(batch) else "apply"
 
 
+# The one shared "apply a fitted node under jit" program. The node is a
+# pytree argument, so jax's own cache keys on its class + static config:
+# every node class gets exactly one trace, refits with new weights reuse
+# the compiled executable, and repeated jitted() calls can't recompile.
+jit_apply = jax.jit(lambda node, batch: node(batch))
+
+
 class _Chainable:
     """Mixin providing ``then`` / ``>>`` composition dispatch."""
 
@@ -122,15 +129,17 @@ class Transformer(_Chainable):
     def jitted(self) -> Callable[[Any], Any]:
         """A jit-compiled version of this (fitted) transformer.
 
-        The node travels as a pytree argument, so for treenode-style nodes
-        (arrays as pytree leaves) re-fitting with new weights reuses the
-        compiled executable. Note this does NOT hold for closures lifted with
-        :func:`transformer` that capture arrays — the closure is static
-        metadata, so each new closure recompiles; use :func:`bind` for
-        weight-carrying lifted nodes.
+        Every ``jitted()`` call shares ONE module-level jit wrapper
+        (:func:`jit_apply`): the node travels as a pytree argument, so the
+        compiled executable is keyed per node class/structure, and two
+        ``jitted()`` calls on the same (or a re-fitted) node hit the same
+        compilation instead of retracing a fresh wrapper each time. Note
+        this does NOT hold for closures lifted with :func:`transformer`
+        that capture arrays — the closure is static metadata, so each new
+        closure recompiles; use :func:`bind` for weight-carrying lifted
+        nodes.
         """
-        fn = jax.jit(lambda node, batch: node(batch))
-        return lambda batch: fn(self, batch)
+        return functools.partial(jit_apply, self)
 
 
 @treenode

@@ -231,8 +231,12 @@ def featurize_stream(
     next chunk while the device computes (JAX dispatch is async — it is
     the ``np.asarray`` force that blocks). The producer side overlaps
     too when the caller wraps its iterator in :func:`prefetch_batches`.
-    ``prefetch=0`` restores the fully synchronous round-trip."""
+    ``prefetch=0`` restores the fully synchronous round-trip. The pad
+    rule and the bounded-inflight drain are shared with
+    :func:`keystone_tpu.core.batching.apply_in_chunks`."""
     from collections import deque
+
+    from keystone_tpu.core.batching import pad_to_chunk
 
     outs = []
     inflight: deque = deque()  # (device result, valid rows)
@@ -244,11 +248,9 @@ def featurize_stream(
 
     for batch in batches:
         for start in range(0, len(batch), chunk_size):
-            chunk = np.asarray(batch[start : start + chunk_size])
-            valid = len(chunk)
-            if valid < chunk_size:
-                pad = [(0, chunk_size - valid)] + [(0, 0)] * (chunk.ndim - 1)
-                chunk = np.pad(chunk, pad)
+            chunk, valid = pad_to_chunk(
+                np.asarray(batch[start : start + chunk_size]), chunk_size
+            )
             if mesh is not None:
                 from keystone_tpu.parallel.mesh import shard_batch
 

@@ -7,12 +7,12 @@ from __future__ import annotations
 
 import os
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
 from keystone_tpu.core.batching import apply_in_chunks
 from keystone_tpu.core.logging import get_logger
+from keystone_tpu.core.pipeline import jit_apply as _apply_node
 from keystone_tpu.ops.gmm import (
     FisherVector,
     GaussianMixtureModel,
@@ -28,10 +28,9 @@ from keystone_tpu.ops.util import MatrixVectorizer
 
 logger = get_logger("keystone_tpu.models.fisher_common")
 
-
-# one jitted apply shared by every branch instance: the node travels as a
-# pytree argument, so new PCA/GMM fits reuse the compiled programs
-_apply_node = jax.jit(lambda node, d: node(d))
+# _apply_node is core.pipeline.jit_apply: ONE process-wide jitted apply —
+# the node travels as a pytree argument, so new PCA/GMM fits reuse the
+# compiled programs, and every other jit_apply user shares the cache
 
 
 class FisherBranch:

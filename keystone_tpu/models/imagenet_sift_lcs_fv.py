@@ -181,13 +181,44 @@ def run_streaming(
     res_cap = max(conf.num_pca_samples, conf.num_gmm_samples)
     res_sift = ColumnReservoir(res_cap, conf.seed)
     res_lcs = ColumnReservoir(res_cap, conf.seed + 1)
-    for imgs, _ in train_source():
-        res_sift.add(
-            _descriptor_cols(apply_in_chunks(sift_fn, imgs, conf.chunk_size))
-        )
-        res_lcs.add(
-            _descriptor_cols(apply_in_chunks(lcs_fn, imgs, conf.chunk_size))
-        )
+    from keystone_tpu import plan as plan_mod
+
+    if plan_mod.enabled():
+        # KEYSTONE_PLAN: both descriptor branches ride one shared
+        # pixel-scaling prefix per chunk (the planner's shared-prefix
+        # fit, in its streaming per-chunk form) — the corpus is scaled
+        # once instead of once per branch, chunk residency unchanged
+        scale_fn = jax.jit(lambda b: PixelScaler()(b))
+        sift_tail = jax.jit(lambda s: sift(GrayScaler()(s)))
+        lcs_tail = jax.jit(lambda s: lcs(s))
+        for imgs, _ in train_source():
+            sift_desc, lcs_desc = plan_mod.apply_shared(
+                scale_fn,
+                (sift_tail, lcs_tail),
+                np.asarray(imgs),
+                chunk_size=conf.chunk_size,
+            )
+            res_sift.add(_descriptor_cols(sift_desc))
+            res_lcs.add(_descriptor_cols(lcs_desc))
+        # one CORPUS pass over pixel scaling eliminated, however many
+        # batches the stream took (apply_shared counts per-call applies)
+        from keystone_tpu.observe import metrics as _metrics
+
+        _metrics.get_registry().counter(
+            "plan_featurize_passes_saved"
+        ).inc()
+    else:
+        for imgs, _ in train_source():
+            res_sift.add(
+                _descriptor_cols(
+                    apply_in_chunks(sift_fn, imgs, conf.chunk_size)
+                )
+            )
+            res_lcs.add(
+                _descriptor_cols(
+                    apply_in_chunks(lcs_fn, imgs, conf.chunk_size)
+                )
+            )
     sift_branch.fit_from_samples(res_sift.sample())
     lcs_branch.fit_from_samples(res_lcs.sample())
     t_sample = time.perf_counter()

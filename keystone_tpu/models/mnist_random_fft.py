@@ -302,8 +302,25 @@ def run(conf: MnistRandomFFTConfig, mesh=None) -> dict:
     )
     test_y = np.zeros(test_x.shape[0], np.int32)
     test_y[:n_test] = test.labels
-    test_blocks = featurize(batch_featurizers, test_x)
-    model.apply_and_evaluate(test_blocks, streaming_eval("test", test_y, n_test))
+    from keystone_tpu import plan as plan_mod
+
+    if plan_mod.enabled():
+        # KEYSTONE_PLAN: the test pass runs through the cost-based
+        # planner's executor — one planned apply pipeline (featurizer
+        # bank → block model → argmax), jitted segments, chunked with
+        # bounded in-flight dispatch when the plan says so. Predictions
+        # are identical to the block path; only the execution differs.
+        bank = FeaturizerBank(batches=tuple(tuple(g) for g in batch_featurizers))
+        pred = plan_mod.execute(
+            Pipeline.of(bank, model, MaxClassifier()), test_x
+        )
+        errors["test"] = evaluator(pred, test_y, n_valid=n_test).error
+        logger.info("test error (planned): %.2f%%", 100 * errors["test"])
+    else:
+        test_blocks = featurize(batch_featurizers, test_x)
+        model.apply_and_evaluate(
+            test_blocks, streaming_eval("test", test_y, n_test)
+        )
     t_end = time.perf_counter()
 
     ev = observe_events.active()

@@ -99,6 +99,12 @@ class CostProfileRegistry:
             self._profiles[label] = profile
         return profile
 
+    def get(self, label: str) -> dict | None:
+        """One node's recorded profile (the planner's join point — the
+        cost source of choice before it falls back to a sampled pass)."""
+        with self._lock:
+            return self._profiles.get(label)
+
     def profile_node(self, node: Callable, batch: Any, label: str | None = None) -> dict:
         """Cost-profile one node applied to ``batch``. The node travels
         as a jit argument (pytree), matching how fitted nodes execute."""

@@ -1,0 +1,118 @@
+"""``python -m keystone_tpu plan <model>`` — print a model's chosen plan.
+
+Builds a small representative apply pipeline for the named model (tiny
+synthetic inputs — no downloads, no full run), plans it with the
+cost-based planner, and prints the plan: nodes, per-row cost estimates,
+cache points, applied rewrites, and the chunk choice. Nothing beyond
+the bounded profiling sample executes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _mnist_pipeline():
+    """Fitted MNIST random-FFT apply pipeline on a tiny synthetic fit."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.core.pipeline import Pipeline
+    from keystone_tpu.models.mnist_random_fft import FeaturizerBank
+    from keystone_tpu.ops.linear import BlockLeastSquaresEstimator
+    from keystone_tpu.ops.util import ClassLabelIndicators, MaxClassifier
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(128, 784)).astype(np.float32))
+    y = ClassLabelIndicators(num_classes=10)(
+        rng.integers(0, 10, size=128).astype(np.int32)
+    )
+    bank = FeaturizerBank.create(num_ffts=2, block_size=1024, seed=0)
+    model = BlockLeastSquaresEstimator(
+        block_size=1024, num_iter=1, lam=1.0
+    ).fit(bank(x), y)
+    return Pipeline.of(bank, model, MaxClassifier()), x
+
+
+def _cifar_pipeline():
+    """CIFAR random-patch conv featurization chain (random filters —
+    the fit-free slice that exercises the conv rewrite rule)."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.ops.images import (
+        Convolver,
+        ImageVectorizer,
+        Pooler,
+        SymmetricRectifier,
+    )
+
+    rng = np.random.default_rng(1)
+    patch, filters = 6, 64
+    d = patch * patch * 3
+    pipe = (
+        Convolver(
+            filters=jnp.asarray(rng.normal(size=(filters, d)).astype(np.float32)),
+            whitener_means=jnp.asarray(rng.normal(size=(d,)).astype(np.float32)),
+            patch_size=patch,
+            normalize_patches=True,
+        )
+        >> SymmetricRectifier(alpha=0.25)
+        >> Pooler(stride=13, pool_size=14)
+        >> ImageVectorizer()
+    )
+    x = jnp.asarray(rng.normal(size=(32, 32, 32, 3)).astype(np.float32))
+    return pipe, x
+
+
+BUILDERS = {
+    "mnist-random-fft": _mnist_pipeline,
+    "cifar-random-patch": _cifar_pipeline,
+}
+
+
+def main(argv: list[str] | None = None) -> None:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m keystone_tpu plan",
+        description=(
+            "print the cost-based planner's chosen plan for a model "
+            "(nodes, costs, cache points, rewrites) without executing it"
+        ),
+    )
+    parser.add_argument("model", choices=sorted(BUILDERS))
+    parser.add_argument(
+        "--chunk-size", type=int, default=None, help="force executor chunk size"
+    )
+    parser.add_argument(
+        "--budget-mb",
+        type=float,
+        default=None,
+        help="memory budget for cached intermediates (default: "
+        "KEYSTONE_PLAN_BUDGET_MB or the device limit)",
+    )
+    parser.add_argument(
+        "--rows",
+        type=int,
+        default=65536,
+        help="assumed execution batch size for the chunk-size choice",
+    )
+    args = parser.parse_args(argv)
+
+    from keystone_tpu import plan as plan_mod
+
+    pipe, probe = BUILDERS[args.model]()
+    plan = plan_mod.plan_pipeline(
+        pipe,
+        sample=probe,
+        budget_bytes=(
+            None if args.budget_mb is None else int(args.budget_mb * 2**20)
+        ),
+        chunk_size=args.chunk_size,
+        n_rows=args.rows,
+    )
+    print(f"{args.model} (sampled on {plan.rows} rows, plan only — not executed)")
+    print(plan.explain())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,153 @@
+"""Cost attachment: join the plan IR against operator profiles.
+
+Two sources, in preference order (KeystoneML samples operator profiles
+at runtime; the TPU compiler hands most of that over statically):
+
+1. The observe cost-profile registry
+   (:mod:`keystone_tpu.observe.cost`) — profiles recorded by an earlier
+   instrumented run of the same pipeline, keyed by the shared node
+   label.
+2. A sampled profiling pass: apply each node to a small probe slice,
+   measuring wall time and asking the compiled program for
+   ``cost_analysis()`` / ``memory_analysis()``. Bounded by the probe
+   size; the probe feeds forward so every node is costed on the shapes
+   it actually sees.
+
+All figures are normalized per input row so a plan sampled on 256 rows
+prices a 1M-row execution.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import jax
+import numpy as np
+
+from keystone_tpu.observe import cost as _cost
+from keystone_tpu.plan.ir import NodeCost, PlanNode
+
+
+def _rows(batch: Any) -> int:
+    leaves = jax.tree_util.tree_leaves(batch)
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        if shape:
+            return int(shape[0])
+    return 1
+
+
+def _out_bytes(out: Any) -> float:
+    total = 0.0
+    for leaf in jax.tree_util.tree_leaves(out):
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is None:
+            size = getattr(leaf, "size", 0)
+            itemsize = getattr(getattr(leaf, "dtype", None), "itemsize", 4)
+            nbytes = size * itemsize
+        total += float(nbytes)
+    return total
+
+
+def cost_from_profile(profile: dict, rows: int) -> NodeCost:
+    """A :class:`NodeCost` from one observe cost-registry profile entry
+    (``cost_profiles.json`` schema), normalized per row."""
+    rows = max(rows, 1)
+    if not profile or "error" in profile:
+        return NodeCost()
+    return NodeCost(
+        flops=float(profile.get("flops", 0.0)) / rows,
+        bytes_accessed=float(profile.get("bytes_accessed", 0.0)) / rows,
+        output_bytes=float(profile.get("output_bytes", 0.0)) / rows,
+        peak_bytes=float(profile.get("peak_bytes", 0.0)) / rows,
+        source="profile",
+    )
+
+
+def _profile_rows(profile: dict) -> int | None:
+    """Rows the profile was recorded on, parsed from its input shapes
+    (``"float32[2048, 784]"``) so normalization uses the profile's own
+    batch size, not the planner's probe size."""
+    shapes = profile.get("input_shapes") or []
+    for s in shapes:
+        lb = s.find("[")
+        if lb < 0:
+            continue
+        head = s[lb + 1 :].split(",")[0].rstrip("]").strip()
+        if head.isdigit():
+            return int(head)
+    return None
+
+
+def from_registry(chain: list[PlanNode], rows: int) -> int:
+    """Fill chain costs from the process cost registry where labels
+    match; returns how many nodes were costed."""
+    registry = _cost.get_cost_registry()
+    hit = 0
+    for pn in chain:
+        profile = registry.get(pn.label)
+        if profile and "error" not in profile:
+            pn.cost = cost_from_profile(
+                profile, _profile_rows(profile) or rows
+            )
+            hit += 1
+    return hit
+
+
+def sample_chain(chain: list[PlanNode], probe: Any) -> Any:
+    """Sampled profiling pass: cost every un-costed node of ``chain`` on
+    ``probe`` (feeding each node's output forward), measuring eager wall
+    time and attaching the compiler's FLOPs/bytes. Returns the final
+    output so multi-branch callers can keep feeding suffix chains.
+
+    A node the sample can't run (host-side op on a probe it rejects)
+    keeps its default cost rather than aborting the plan — the planner
+    then simply has no basis to prefer rewriting/caching it.
+    """
+    rows = max(_rows(probe), 1)
+    for pn in chain:
+        if pn.cost.source != "default":
+            # registry-costed already: only advance the probe — no
+            # compile/cost-analysis pass for nodes the registry covers
+            try:
+                probe = pn.op(probe)
+            except Exception:  # noqa: BLE001 — can't feed further nodes
+                return probe
+            continue
+        try:
+            profile = _cost.analyze(lambda n, b: n(b), pn.op, probe)
+            t0 = time.perf_counter()
+            out = jax.block_until_ready(pn.op(probe))
+            wall = time.perf_counter() - t0
+        except Exception:  # noqa: BLE001 — uncostable node, keep defaults
+            return probe
+        pn.cost = cost_from_profile(profile, rows)
+        pn.cost.wall_s = wall / rows
+        pn.cost.source = "sampled"
+        if not pn.cost.output_bytes:
+            pn.cost.output_bytes = _out_bytes(out) / rows
+        probe = out
+    return probe
+
+
+def attach(
+    chain: list[PlanNode], sample: Any | None, rows_hint: int | None = None
+) -> None:
+    """Cost a chain: registry profiles first, sampled pass for the rest."""
+    rows = rows_hint or (_rows(sample) if sample is not None else 1)
+    from_registry(chain, rows)
+    if sample is not None and any(
+        pn.cost.source == "default" for pn in chain
+    ):
+        sample_chain(chain, sample)
+
+
+def slice_probe(data: Any, rows: int = 256) -> Any:
+    """A bounded probe slice of ``data`` for the sampling pass."""
+    n = _rows(data)
+    if n <= rows:
+        return data
+    if isinstance(data, (np.ndarray, jax.Array)):
+        return data[:rows]
+    return jax.tree_util.tree_map(lambda leaf: leaf[:rows], data)

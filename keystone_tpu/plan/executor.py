@@ -1,0 +1,318 @@
+"""Plan-aware executor: jitted segments, chunked dispatch, shared prefixes.
+
+``run_plan`` executes a planned pipeline the way the plan says to:
+
+- the node chain is cut into **segments** at materialization points
+  (planner-chosen cache points plus explicit ``Cacher`` nodes); each
+  segment runs as ONE jitted program (the shared
+  :func:`keystone_tpu.core.pipeline.jit_apply` wrapper, so repeated
+  executions hit the same executables),
+- when the plan chose a chunk size, a segment streams through
+  :func:`keystone_tpu.core.batching.apply_in_chunks` with bounded
+  in-flight dispatch — the ``featurize_stream`` idiom promoted into the
+  core execution path,
+- at each materialization point the intermediate is forced resident
+  (``block_until_ready`` — the ``Cacher`` semantic), and the *previous*
+  segment's dead intermediate is freed eagerly so peak residency is one
+  live intermediate per boundary, not the whole chain,
+- a multi-branch plan runs the shared prefix once and fans its
+  materialized output out to every branch (or recomputes per branch when
+  the budget refused the cache — the planner's call, not ours).
+
+``fit_shared`` applies the same machinery to the *fit* path: several
+chained estimators riding one featurization prefix pay for that prefix
+once.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import numpy as np
+
+from keystone_tpu.core.batching import apply_in_chunks, pad_to_chunk
+from keystone_tpu.core.pipeline import (
+    Cacher,
+    ChainedEstimator,
+    ChainedLabelEstimator,
+    FnTransformer,
+    FunctionNode,
+    Pipeline,
+    jit_apply,
+    _fit_entry,
+    _guard_feats,
+)
+from keystone_tpu.observe import events as _events
+from keystone_tpu.observe import metrics as _metrics
+from keystone_tpu.plan.ir import Plan, PlanNode
+
+
+def _chunkable_node(node: Any) -> bool:
+    """Transformers are row-wise by contract; FunctionNode lifts are the
+    escape hatch for whole-dataset ops and must never be chunked."""
+    if isinstance(node, FnTransformer) and isinstance(node.fn, FunctionNode):
+        return False
+    return not isinstance(node, FunctionNode)
+
+
+def _chunkable(ops: Sequence[Any], data: Any) -> bool:
+    return isinstance(data, (np.ndarray, jax.Array)) and all(
+        _chunkable_node(op) for op in ops
+    )
+
+
+def _row_indexed_output(seg_pipe: Pipeline, data: Any) -> bool:
+    """True when the segment maps a batch to a row-indexed ARRAY — the
+    shape ``apply_in_chunks`` can pad, trim, and concatenate. A segment
+    whose output is a pytree (e.g. a featurizer bank's list of blocks)
+    must run unchunked: slicing a list with ``[:valid]`` would silently
+    drop blocks, not pad rows. Checked on a 1-row probe, so the cost is
+    one tiny eager dispatch per chunked segment."""
+    try:
+        out = seg_pipe(data[:1])
+    except Exception:  # noqa: BLE001 — a probe the segment rejects
+        return False
+    return (
+        isinstance(out, (np.ndarray, jax.Array))
+        and getattr(out, "ndim", 0) >= 1
+        and out.shape[0] == 1
+    )
+
+
+def _segments(chain: list[PlanNode]) -> list[list[PlanNode]]:
+    """Cut a chain at materialization points (after the flagged node)."""
+    segs: list[list[PlanNode]] = [[]]
+    for pn in chain:
+        segs[-1].append(pn)
+        if pn.materialize or isinstance(pn.op, Cacher):
+            segs.append([])
+    return [s for s in segs if s]
+
+
+def _free(tree: Any, keep: Any) -> None:
+    """Eagerly release a dead intermediate's device buffers. ``keep``
+    leaves are never deleted (an aliasing no-op segment could hand the
+    same Array straight through)."""
+    keep_ids = {id(leaf) for leaf in jax.tree_util.tree_leaves(keep)}
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if isinstance(leaf, jax.Array) and id(leaf) not in keep_ids:
+            try:
+                leaf.delete()
+            except Exception:  # noqa: BLE001 — committed/donated buffer
+                pass
+
+
+def _run_chain(
+    chain: list[PlanNode], data: Any, plan: Plan, *, own_input: bool = False
+) -> Any:
+    """Execute one chain: jitted segments between materialization points,
+    chunked when the plan chose a chunk size. ``own_input`` marks ``data``
+    as a planner-created intermediate that may be freed once consumed."""
+    reg = _metrics.get_registry()
+    out = data
+    owned = own_input
+    for seg in _segments(chain):
+        ops = [pn.op for pn in seg]
+        seg_pipe = Pipeline(nodes=tuple(ops))
+        prev = out
+        if plan.chunk_size and _chunkable(ops, out):
+            # 1-row output probe memoized on the segment head: a plan is
+            # static, so repeated executions must not re-pay the probe
+            chunk_ok = getattr(seg[0], "_chunk_probe_ok", None)
+            if chunk_ok is None:
+                chunk_ok = _row_indexed_output(seg_pipe, out)
+                seg[0]._chunk_probe_ok = chunk_ok
+        else:
+            chunk_ok = False
+        if chunk_ok:
+            out = apply_in_chunks(
+                lambda b, p=seg_pipe: jit_apply(p, b),
+                out,
+                plan.chunk_size,
+                inflight=max(plan.prefetch, 0),
+            )
+            reg.counter("plan_chunked_executions").inc()
+        else:
+            out = jit_apply(seg_pipe, out)
+        if seg[-1].materialize or isinstance(seg[-1].op, Cacher):
+            out = jax.block_until_ready(out)
+        reg.counter("plan_segments_executed").inc()
+        if owned:
+            _free(prev, keep=out)
+        owned = True
+    return out
+
+
+def run_plan(plan: Plan, data: Any) -> Any:
+    """Execute a plan on ``data``. Single-chain plans return the chain
+    output; multi-branch plans return one output per branch."""
+    if not plan.branches:
+        return _run_chain(plan.prefix, data, plan)
+    reg = _metrics.get_registry()
+    if plan.share_prefix and plan.prefix:
+        feats = jax.block_until_ready(_run_chain(plan.prefix, data, plan))
+        # per-call unit (see apply_shared): corpus-level passes-saved
+        # accounting belongs to the caller that knows the corpus
+        reg.counter("plan_shared_prefix_applies").inc()
+        outs = [_run_chain(b, feats, plan) for b in plan.branches]
+        _free(feats, keep=outs)
+        return outs
+    return [
+        _run_chain(plan.prefix + branch, data, plan)
+        for branch in plan.branches
+    ]
+
+
+def fit_shared(
+    chains: Sequence[ChainedEstimator | ChainedLabelEstimator],
+    data: Any,
+    labels: Any = None,
+    *,
+    budget_bytes: int | None = None,
+    sample: Any | None = None,
+    **kw: Any,
+) -> list[Pipeline]:
+    """Fit several chained estimators that share a featurization prefix,
+    paying for the shared prefix ONCE (the multi-branch fit the paper's
+    optimizer exists for: e.g. SIFT → sample → {PCA fit, GMM fit} off one
+    featurization). Returns one fitted ``Pipeline`` per chain, in order —
+    each identical to what ``chain.fit(...)`` would have produced.
+
+    The shared prefix is the longest common run of node objects across
+    the chains' prefixes (object identity — share nodes to share work).
+    Whether the shared intermediate is actually materialized is a budget
+    decision (:func:`keystone_tpu.plan.passes.choose_materialization`);
+    when the budget refuses it, every chain simply fits the naive way.
+    """
+    from keystone_tpu.plan import _assemble_fit_plan
+
+    chains = list(chains)
+    if not chains:
+        return []
+    plan, shared_nodes = _assemble_fit_plan(
+        chains,
+        sample=sample,
+        budget_bytes=budget_bytes,
+        # residency is priced at the real fit size: the shared
+        # intermediate lives for the whole multi-branch fit
+        n_rows=_exec_rows(data),
+    )
+    if not shared_nodes or not plan.share_prefix:
+        return [_fit_one(c, data, labels, **kw) for c in chains]
+
+    reg = _metrics.get_registry()
+    data = _fit_entry(data)
+    shared_pipe = Pipeline(nodes=tuple(shared_nodes))
+    with _node_span(_events.node_label(shared_pipe), "apply"):
+        feats = jax.block_until_ready(
+            _run_chain(plan.prefix, data, plan)
+        )
+    _guard_feats(_events.node_label(shared_pipe), feats)
+    reg.counter("plan_prefix_shared").inc()
+    reg.counter("plan_featurize_passes_saved").inc(len(chains) - 1)
+
+    fitted: list[Pipeline] = []
+    for chain in chains:
+        rest = _prefix_nodes(chain)[len(shared_nodes) :]
+        branch_feats = feats
+        if rest:
+            branch_feats = Pipeline(nodes=tuple(rest))(feats)
+        with _node_span(_events.node_label(chain.est), "fit"):
+            if isinstance(chain, ChainedLabelEstimator):
+                model = chain.est.fit(branch_feats, labels, **kw)
+            else:
+                model = chain.est.fit(branch_feats, **kw)
+        fitted.append(Pipeline.of(chain.prefix, model))
+    return fitted
+
+
+def _exec_rows(data: Any) -> int:
+    from keystone_tpu.plan.costs import _rows
+
+    return _rows(data)
+
+
+def _fit_one(chain, data, labels, **kw):
+    if isinstance(chain, ChainedLabelEstimator):
+        return chain.fit(data, labels, **kw)
+    return chain.fit(data, **kw)
+
+
+def _prefix_nodes(chain) -> list[Any]:
+    prefix = chain.prefix
+    if isinstance(prefix, Pipeline):
+        return list(prefix.nodes)
+    return [prefix]
+
+
+def shared_prefix_nodes(chains: Sequence[Any]) -> list[Any]:
+    """Longest common (by object identity) leading node run across the
+    chains' prefixes."""
+    node_lists = [_prefix_nodes(c) for c in chains]
+    shared: list[Any] = []
+    for nodes in zip(*node_lists):
+        if all(n is nodes[0] for n in nodes):
+            shared.append(nodes[0])
+        else:
+            break
+    return shared
+
+
+def apply_shared(
+    prefix_fn: Callable,
+    branch_fns: Sequence[Callable],
+    data,
+    *,
+    chunk_size: int,
+    inflight: int = 2,
+    to_host: bool = False,
+) -> list:
+    """Chunked shared-prefix apply: for each fixed-size chunk, run
+    ``prefix_fn`` ONCE and feed its output to every branch — the
+    per-chunk form of prefix sharing for streaming passes whose shared
+    intermediate must never materialize corpus-wide (e.g. pixel-scaled
+    images feeding both the SIFT and LCS descriptor branches). Returns
+    one concatenated output per branch; bounded in-flight dispatch as in
+    :func:`keystone_tpu.core.batching.apply_in_chunks`."""
+    from collections import deque
+
+    reg = _metrics.get_registry()
+    outs: list[list] = [[] for _ in branch_fns]
+    pending: list[deque] = [deque() for _ in branch_fns]
+
+    def drain(limit: int):
+        for j, q in enumerate(pending):
+            while len(q) > limit:
+                out, valid = q.popleft()
+                outs[j].append(
+                    np.asarray(out)[:valid]
+                    if to_host
+                    else jax.block_until_ready(out)[:valid]
+                )
+
+    n = data.shape[0]
+    for start in range(0, n, chunk_size):
+        chunk, valid = pad_to_chunk(data[start : start + chunk_size], chunk_size)
+        shared = prefix_fn(chunk)
+        for j, fn in enumerate(branch_fns):
+            pending[j].append((fn(shared), valid))
+        drain(max(inflight, 0))
+    drain(0)
+    if len(branch_fns) > 1:
+        # per-call unit is "chunked applies that shared a prefix" — the
+        # corpus-level passes-saved accounting belongs to the CALLER
+        # (one stream = one saved pass, however many batches it took),
+        # so a batch loop can't inflate the headline counter
+        reg.counter("plan_shared_prefix_applies").inc()
+    if to_host:
+        return [np.concatenate(o, axis=0) for o in outs]
+    import jax.numpy as jnp
+
+    return [jnp.concatenate(o, axis=0) for o in outs]
+
+
+def _node_span(name: str, phase: str):
+    from keystone_tpu.core.pipeline import _node_span as span
+
+    return span(name, phase)

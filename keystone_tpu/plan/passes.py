@@ -1,0 +1,290 @@
+"""Registered optimizer passes over the plan IR.
+
+Generalizes what :mod:`keystone_tpu.core.fusion` used to hard-code (one
+conv-chain rewrite inlined in ``optimize``) into a registry: rewrite
+rules register themselves with :func:`rewrite_rule` and every planner
+run (and ``fusion.optimize``, which now delegates here) slides each
+rule's window over the chain. The other two passes implement the
+KeystoneML cost model, adapted to device memory:
+
+- :func:`choose_materialization` — greedy automatic caching: cache an
+  intermediate iff ``(reuse − 1) × recompute_cost`` exceeds its
+  residency penalty, taking candidates by benefit density until the
+  HBM/host budget is spent (the paper's algorithm 1, with bytes-resident
+  standing in for Spark's storage fraction).
+- :func:`choose_chunk_size` — operator selection for the chunked
+  executor: pick the largest power-of-two chunk whose peak working set
+  fits the budget fraction reserved for in-flight work.
+
+Passes only mutate the plan IR and record decisions; they never touch
+user pipelines in place.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Sequence
+
+from keystone_tpu.observe import events as _events
+from keystone_tpu.observe import metrics as _metrics
+from keystone_tpu.plan.ir import NodeCost, Plan, PlanNode
+
+# ---------------------------------------------------------------------------
+# rewrite-rule registry
+
+
+@dataclasses.dataclass(frozen=True)
+class RewriteRule:
+    """A window rewrite: ``fn(*nodes) -> fused node | None``."""
+
+    name: str
+    window: int
+    fn: Callable[..., Any]
+
+
+_RULES: list[RewriteRule] = []
+
+
+def rewrite_rule(name: str, window: int):
+    """Decorator registering a node-window rewrite rule. Rules are tried
+    in registration order at each chain position; the first match wins
+    and the cursor advances past the fused node."""
+
+    def register(fn):
+        _RULES.append(RewriteRule(name=name, window=window, fn=fn))
+        return fn
+
+    return register
+
+
+def registered_rules() -> tuple[RewriteRule, ...]:
+    _ensure_rules_loaded()
+    return tuple(_RULES)
+
+
+def _ensure_rules_loaded() -> None:
+    # the conv-chain rule lives with its node definitions in core.fusion;
+    # importing it here (lazily, to dodge the core→plan→core cycle at
+    # module import) guarantees registration before any rewrite walk
+    import keystone_tpu.core.fusion  # noqa: F401
+
+
+def rewrite_nodes(nodes: Sequence[Any]) -> tuple[list[Any], list[dict]]:
+    """Slide every registered rule over a raw transformer chain. Returns
+    the rewritten node list plus one decision record per application.
+    One walk serves both entry points: this lifts the chain into a
+    throwaway plan and reuses the planner's :func:`_rewrite_chain`, so
+    ``fusion.optimize`` and the planner can never drift on rule order or
+    window semantics."""
+    chain = [
+        PlanNode(label=_events.node_label(n, i), op=n)
+        for i, n in enumerate(nodes)
+    ]
+    plan = Plan(prefix=chain, budget_bytes=0)
+    # count_metrics=False: the classic fusion path reports under its own
+    # fusion_rewrites family — bumping plan_rewrites here would claim
+    # planner activity on runs where the planner never ran
+    _rewrite_chain(plan, chain, count_metrics=False)
+    return [pn.op for pn in chain], plan.decisions
+
+
+# ---------------------------------------------------------------------------
+# plan passes
+
+
+def select_operators(plan: Plan) -> Plan:
+    """Rewrite pass over the plan chain: apply registered rules, folding
+    each replaced window's cost into the fused node (sum — the fused
+    node does at most the work of its parts) and recording the decision
+    in the plan, the metrics registry, and the event log."""
+    for chain in [plan.prefix, *plan.branches]:
+        _rewrite_chain(plan, chain)
+    return plan
+
+
+def _rewrite_chain(
+    plan: Plan, chain: list[PlanNode], count_metrics: bool = True
+) -> None:
+    i = 0
+    while i < len(chain):
+        applied = None
+        for rule in registered_rules():
+            if i + rule.window > len(chain):
+                continue
+            window = chain[i : i + rule.window]
+            if any(pn.materialize for pn in window[:-1]):
+                continue  # never fuse across a chosen cache point
+            fused = rule.fn(*(pn.op for pn in window))
+            if fused is not None:
+                applied = (rule, window, fused)
+                break
+        if applied is None:
+            i += 1
+            continue
+        rule, window, fused = applied
+        cost = NodeCost(
+            flops=sum(pn.cost.flops for pn in window),
+            bytes_accessed=sum(pn.cost.bytes_accessed for pn in window),
+            output_bytes=window[-1].cost.output_bytes,
+            peak_bytes=max(pn.cost.peak_bytes for pn in window),
+            wall_s=(
+                sum(pn.cost.wall_s or 0.0 for pn in window)
+                if any(pn.cost.wall_s is not None for pn in window)
+                else None
+            ),
+            source=window[0].cost.source,
+        )
+        label = _events.node_label(fused, i)
+        chain[i : i + rule.window] = [
+            PlanNode(
+                label=label,
+                op=fused,
+                cost=cost,
+                reuse=window[-1].reuse,
+                materialize=window[-1].materialize,
+                rewritten_from=tuple(pn.label for pn in window),
+            )
+        ]
+        plan.decide(
+            "rewrite",
+            rule=rule.name,
+            node=label,
+            replaced=[pn.label for pn in window],
+        )
+        if count_metrics:
+            _metrics.get_registry().counter(
+                "plan_rewrites", rule=rule.name
+            ).inc()
+        i += 1
+
+
+def choose_materialization(plan: Plan, rows: int | None = None) -> Plan:
+    """Greedy automatic caching under the plan's memory budget.
+
+    A node is a candidate iff its output is reused (``reuse > 1``) —
+    in practice the tail of a shared featurization prefix. Benefit is
+    the recompute time the cache saves, ``(reuse − 1) × recompute_s``;
+    the residency penalty is its output's resident bytes. Candidates are
+    taken in benefit-density order while they fit the budget, exactly
+    the paper's greedy knapsack. Unknown costs count as zero bytes /
+    infinite benefit: with no information, sharing a reused prefix is
+    strictly better than recomputing it.
+    """
+    rows = rows or max(plan.rows, 1)
+    reg = _metrics.get_registry()
+    # benefit of caching node i = (reuse − 1) × recomputing the WHOLE
+    # upstream chain through i: without the cache, every extra consumer
+    # pays the prefix again from the source, not just the tail node
+    cumulative: dict[int, float] = {}
+    running, any_costed = 0.0, False
+    for pn in plan.prefix:
+        if pn.cost.source != "default":
+            any_costed = True
+            running += pn.cost.recompute_s(rows, plan.device_kind)
+        cumulative[id(pn)] = running
+    candidates = [
+        pn for pn in plan.prefix if pn.reuse > 1 and not pn.materialize
+    ]
+
+    def benefit(pn: PlanNode) -> float:
+        if not any_costed:
+            # no cost information at all: with a reused prefix, sharing
+            # is strictly better than blind recomputation
+            return float("inf")
+        return (pn.reuse - 1) * cumulative[id(pn)]
+
+    def resident(pn: PlanNode) -> float:
+        return pn.cost.output_bytes * rows
+
+    candidates.sort(
+        key=lambda pn: benefit(pn) / max(resident(pn), 1.0), reverse=True
+    )
+    spent = 0.0
+    for pn in candidates:
+        bytes_needed = resident(pn)
+        fits = spent + bytes_needed <= plan.budget_bytes
+        if fits and benefit(pn) > 0.0:
+            pn.materialize = True
+            spent += bytes_needed
+            plan.decide(
+                "cache",
+                node=pn.label,
+                reuse=pn.reuse,
+                benefit_s=round(benefit(pn), 6)
+                if benefit(pn) != float("inf")
+                else "unknown",
+                resident_bytes=int(bytes_needed),
+                budget_bytes=plan.budget_bytes,
+            )
+            reg.counter("plan_cache_inserted").inc()
+        else:
+            plan.decide(
+                "no_cache",
+                node=pn.label,
+                reuse=pn.reuse,
+                reason="over_budget" if not fits else "no_benefit",
+                resident_bytes=int(bytes_needed),
+                budget_bytes=plan.budget_bytes,
+            )
+    # a shared prefix whose tail the budget refused must be recomputed
+    # per consumer — the executor reads this flag
+    plan.share_prefix = not plan.branches or (
+        bool(plan.prefix) and plan.prefix[-1].materialize
+    )
+    return plan
+
+
+def choose_chunk_size(
+    plan: Plan,
+    n_rows: int,
+    *,
+    requested: int | None = None,
+    budget_fraction: float = 0.25,
+) -> Plan:
+    """Operator selection for the chunked executor: bound the per-chunk
+    working set to ``budget_fraction`` of the memory budget using the
+    chain's worst per-row peak bytes; chunk sizes are powers of two so
+    repeated plans hit the same compiled executables."""
+    if requested is not None:
+        plan.chunk_size = requested
+        plan.decide("chunk", size=requested, source="requested")
+        return plan
+    peak_row = max(
+        (
+            pn.cost.peak_bytes
+            for chain in [plan.prefix, *plan.branches]
+            for pn in chain
+        ),
+        default=0.0,
+    )
+    if peak_row <= 0.0 or plan.budget_bytes <= 0:
+        return plan  # no basis for a choice — executor stays unchunked
+    limit = max(int(plan.budget_bytes * budget_fraction / peak_row), 1)
+    if limit >= n_rows:
+        plan.decide("chunk", size=None, reason="fits_whole_batch")
+        return plan
+    size = 1 << max(limit.bit_length() - 1, 0)
+    plan.chunk_size = size
+    plan.decide(
+        "chunk",
+        size=size,
+        peak_bytes_per_row=int(peak_row),
+        budget_bytes=plan.budget_bytes,
+    )
+    return plan
+
+
+def emit_plan(plan: Plan) -> None:
+    """Record the finished plan in the event log (one ``optimize`` event
+    carrying every decision) so rewrites are observable per run."""
+    log = _events.active()
+    if log is not None and plan.decisions:
+        log.emit(
+            "optimize",
+            source="planner",
+            nodes=[pn.label for pn in plan.prefix],
+            branches=[[pn.label for pn in b] for b in plan.branches],
+            chunk_size=plan.chunk_size,
+            budget_bytes=plan.budget_bytes,
+            decisions=plan.decisions,
+        )
