@@ -203,14 +203,16 @@ def _mnist_per_node_breakdown(fitted, x) -> dict:
         return collect(log)
 
 
-def _mnist_planner_record(fitted, x, y, n) -> dict:
+def _mnist_planner_record(fitted, x, y, n, mesh=None) -> dict:
     """Planned-vs-naive record for the fitted MNIST pipeline: the
     cost-based planner's executor against the plain eager apply on the
-    same probe, plus a shared-prefix fit (two solvers riding ONE
-    featurizer bank) whose metrics-counter delta shows the planner
-    eliminating a redundant featurization pass. Decisions ride along so
-    the perf trajectory records WHAT the planner chose, not just the
-    delta."""
+    same probe, plus — on a multi-device host — the same plan dispatched
+    data-sharded over the mesh (the sharded-planned vs single-device-
+    planned delta, with the staging engine's transfer counters), plus a
+    shared-prefix fit (two solvers riding ONE featurizer bank) whose
+    metrics-counter delta shows the planner eliminating a redundant
+    featurization pass. Decisions ride along so the perf trajectory
+    records WHAT the planner chose, not just the delta."""
     import jax
 
     from keystone_tpu import plan as plan_mod
@@ -226,6 +228,37 @@ def _mnist_planner_record(fitted, x, y, n) -> dict:
         pipe, sample=probe[:256], n_rows=probe.shape[0]
     )
     planned_s = _timed(lambda: plan.execute(probe), iters=4)
+
+    sharded = None
+    if mesh is not None and len(jax.devices()) > 1:
+        plan_sharded = plan_mod.plan_pipeline(
+            pipe, sample=probe[:256], n_rows=probe.shape[0], mesh=mesh
+        )
+        plan_sharded.execute(probe)  # warm the executables
+        # counter deltas bracket ONE execution, so transfer_bytes is
+        # comparable to the probe's nbytes (timed reps would inflate 5x)
+        reg0 = observe_metrics.get_registry().snapshot()
+        plan_sharded.execute(probe)
+        snap = observe_metrics.get_registry().snapshot()
+        sharded_s = _timed(lambda: plan_sharded.execute(probe), iters=4)
+        from keystone_tpu.parallel.mesh import data_axis_size
+
+        sharded = {
+            "sharded_planned_ms": round(sharded_s * 1e3, 2),
+            "sharded_vs_single_planned": round(planned_s / sharded_s, 3),
+            "shards": data_axis_size(mesh),
+            "stage_depth": plan_sharded.stage_depth,
+            "transfer_metrics": {
+                k: snap.get(k, 0) - reg0.get(k, 0)
+                for k in (
+                    "plan_transfer_chunks",
+                    "plan_transfer_bytes",
+                    "plan_shard_chunks",
+                    "plan_shard_dispatches",
+                )
+            },
+            "decisions": plan_sharded.decisions,
+        }
 
     bank = fitted.nodes[0]
     chains = [
@@ -245,7 +278,7 @@ def _mnist_planner_record(fitted, x, y, n) -> dict:
     )
     shared_fit_s = time.perf_counter() - t0
     saved = reg.snapshot().get("plan_featurize_passes_saved", 0) - saved_before
-    return {
+    rec = {
         "naive_apply_ms": round(naive_s * 1e3, 2),
         "planned_apply_ms": round(planned_s * 1e3, 2),
         "planned_vs_naive": round(naive_s / planned_s, 3),
@@ -257,6 +290,9 @@ def _mnist_planner_record(fitted, x, y, n) -> dict:
             "fit_s": round(shared_fit_s, 3),
         },
     }
+    if sharded is not None:
+        rec["sharded"] = sharded
+    return rec
 
 
 def bench_mnist(labels: np.ndarray, data: np.ndarray) -> dict:
@@ -298,7 +334,7 @@ def bench_mnist(labels: np.ndarray, data: np.ndarray) -> dict:
         # the bench its headline number
         per_node = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
     try:
-        planner = _mnist_planner_record(fitted_box["pipe"], x, y, n)
+        planner = _mnist_planner_record(fitted_box["pipe"], x, y, n, mesh=mesh)
     except Exception as e:  # noqa: BLE001 — same rule for the planner
         planner = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
     d = NUM_FFTS * 512  # total feature width

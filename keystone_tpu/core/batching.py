@@ -9,15 +9,17 @@ output on the host or device.
 
 Shared with :func:`keystone_tpu.loaders.streaming.featurize_stream`:
 :func:`pad_to_chunk` (one home of the pad-to-static-shape rule) and the
-bounded-inflight deque drain — up to ``inflight`` chunk results stay
-un-forced so the host keeps dispatching while the device computes, but
-never more, so device/host residency stays a small constant instead of
-the whole output piling up un-forced behind an async dispatch queue.
+staged drain engine (:func:`keystone_tpu.core.staging.run_staged`) —
+chunk k+1's host→device transfer overlaps chunk k's compute, up to
+``inflight`` chunk results stay un-forced so the host keeps dispatching
+while the device computes, but never more, so device/host residency
+stays a small constant instead of the whole output piling up un-forced
+behind an async dispatch queue. With a ``sharding`` each staged chunk
+is placed across the mesh and the call runs as one SPMD program.
 """
 
 from __future__ import annotations
 
-from collections import deque
 from typing import Callable
 
 import jax
@@ -50,39 +52,54 @@ def apply_in_chunks(
     *,
     to_host: bool = False,
     inflight: int = 2,
+    sharding=None,
+    stage_depth: int | None = None,
+    shard_multiple: int | None = None,
 ):
     """Apply ``fn`` (ideally jitted) to ``data`` in fixed-size chunks along
     axis 0. The last chunk is zero-padded to ``chunk_size`` (one executable)
     and its padding rows are dropped from the result.
 
-    ``inflight`` bounds un-forced chunk results (same backpressure as
-    ``featurize_stream``): once more than that many are pending, the
-    oldest is forced — to the host when ``to_host``, else just completed
-    on device — before the next chunk dispatches. ``inflight=0`` restores
-    the fully synchronous round-trip.
+    Chunks are staged host→device ahead of use (double-buffered;
+    ``stage_depth`` / ``KEYSTONE_STAGE_DEPTH`` bounds the staged depth)
+    and, with a ``sharding``, placed across the mesh so each chunk runs
+    as one SPMD program — ``chunk_size`` must then divide evenly over
+    the data axis, and ``shard_multiple`` (the data-axis size) lets a
+    batch smaller than the chunk pad only to the next shard multiple. ``inflight`` bounds un-forced chunk results (same
+    backpressure as ``featurize_stream``): once more than that many are
+    pending, the oldest is forced — to the host when ``to_host``, else
+    just completed on device — before the next chunk dispatches.
+    ``inflight=0`` restores the fully synchronous round-trip.
     """
+    from keystone_tpu.core.staging import run_staged
+
     n = data.shape[0]
-    if n <= chunk_size:
+    if n <= chunk_size and sharding is None:
         out = fn(data)
         return np.asarray(out) if to_host else out
-    outs = []
-    pending: deque = deque()  # (result, valid rows)
+    if sharding is not None and shard_multiple:
+        # a batch smaller than the chunk must not pad all the way up to
+        # chunk_size (16x wasted transfer+compute on a 64-row batch with
+        # a 1024-row plan) — the next shard multiple is enough for even
+        # static shard shapes
+        chunk_size = min(
+            chunk_size, -(-n // shard_multiple) * shard_multiple
+        )
 
-    def force(item):
-        out, valid = item
-        if to_host:
-            return np.asarray(out)[:valid]
-        return jax.block_until_ready(out)[:valid]
+    def chunks():
+        for start in range(0, n, chunk_size):
+            yield pad_to_chunk(data[start : start + chunk_size], chunk_size)
 
-    def drain(limit: int):
-        while len(pending) > limit:
-            outs.append(force(pending.popleft()))
-
-    for start in range(0, n, chunk_size):
-        chunk, valid = pad_to_chunk(data[start : start + chunk_size], chunk_size)
-        pending.append((fn(chunk), valid))
-        drain(max(inflight, 0))
-    drain(0)
+    outs = list(
+        run_staged(
+            chunks(),
+            fn,
+            sharding=sharding,
+            stage_depth=stage_depth,
+            inflight=inflight,
+            to_host=to_host,
+        )
+    )
     if to_host:
         return np.concatenate(outs, axis=0)
     import jax.numpy as jnp

@@ -1,0 +1,295 @@
+"""Double-buffered host→device staging: ONE engine behind every chunk drain.
+
+Three call sites used to carry near-identical bounded-inflight drain
+loops — :func:`keystone_tpu.core.batching.apply_in_chunks`,
+:func:`keystone_tpu.loaders.streaming.featurize_stream`, and
+:func:`keystone_tpu.plan.executor.apply_shared`. They all route through
+:func:`run_staged` now, which adds the piece none of them had: chunk
+k+1's host→device transfer starts (async ``jax.device_put``, optionally
+with a mesh sharding spec) while chunk k computes, so PCIe latency hides
+behind device work — the input-pipeline overlap story of tf.data
+(arxiv 2101.12127) applied to KeystoneML-style chunked passes.
+
+Two layers:
+
+- :func:`stage_chunks` — a staging thread pulls ``(host_chunk, valid)``
+  pairs from the caller's iterator and places each on the device(s)
+  ahead of consumption, bounded to ``depth`` staged-but-unconsumed
+  chunks (``depth=2`` is classic double buffering;
+  ``KEYSTONE_STAGE_DEPTH`` overrides, ``0`` stages inline/synchronous).
+  Producer exceptions re-raise at the consumer; closing the consumer
+  generator retires the thread and frees any parked staged buffers.
+- :func:`run_staged` — dispatch a function over the staged stream with
+  the bounded un-forced-result drain (up to ``inflight`` results stay
+  un-forced so the host keeps dispatching while the device computes),
+  then free each dead staged input once the result that consumed it has
+  been forced — peak device residency stays a small constant:
+  ``depth`` staged inputs + ``inflight`` un-forced outputs.
+
+Transfers are observable: ``plan_transfer_*`` / ``plan_shard_*`` metrics
+counters, and one ``optimize`` event (``source="staging"``) per staged
+stream when a run log is active.
+"""
+
+from __future__ import annotations
+
+import os
+import queue as _queue
+import threading
+from collections import deque
+from typing import Any, Callable, Iterable, Iterator
+
+import jax
+import numpy as np
+
+ENV_STAGE_DEPTH = "KEYSTONE_STAGE_DEPTH"
+_DEFAULT_DEPTH = 2
+
+
+def default_stage_depth() -> int:
+    """Staged-chunk depth: ``KEYSTONE_STAGE_DEPTH`` override, else 2
+    (double buffering). ``0`` disables the staging thread entirely."""
+    raw = os.environ.get(ENV_STAGE_DEPTH, "").strip()
+    if raw:
+        try:
+            return max(int(raw), 0)
+        except ValueError:
+            pass
+    return _DEFAULT_DEPTH
+
+
+def _nbytes(chunk: Any) -> int:
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(chunk):
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is None:
+            size = getattr(leaf, "size", 0)
+            itemsize = getattr(getattr(leaf, "dtype", None), "itemsize", 4)
+            nbytes = size * itemsize
+        total += int(nbytes)
+    return total
+
+
+def _buffer_pointers(tree: Any) -> set[int]:
+    """Best-effort device-buffer identity for alias detection: the set of
+    raw buffer pointers under a pytree's arrays (per-shard for sharded
+    arrays). Arrays whose backend exposes no pointer contribute nothing —
+    the caller then falls back to object identity only."""
+    ptrs: set[int] = set()
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if not isinstance(leaf, jax.Array):
+            continue
+        try:
+            for shard in leaf.addressable_shards:
+                ptrs.add(shard.data.unsafe_buffer_pointer())
+        except Exception:  # noqa: BLE001 — deleted/donated or no pointer API
+            try:
+                ptrs.add(leaf.unsafe_buffer_pointer())
+            except Exception:  # noqa: BLE001
+                pass
+    return ptrs
+
+
+def free_buffers(tree: Any, keep: Any = ()) -> None:
+    """Eagerly release a dead intermediate's device buffers.
+
+    Leaves that are a leaf of ``keep`` — by object identity OR by
+    sharing a device buffer (a passthrough jit segment can alias its
+    input into its output without copying) — are never deleted.
+    """
+    keep_ids = {id(leaf) for leaf in jax.tree_util.tree_leaves(keep)}
+    keep_ptrs = _buffer_pointers(keep)
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if not isinstance(leaf, jax.Array) or id(leaf) in keep_ids:
+            continue
+        if keep_ptrs and (_buffer_pointers(leaf) & keep_ptrs):
+            continue
+        try:
+            leaf.delete()
+        except Exception:  # noqa: BLE001 — committed/donated buffer
+            pass
+
+
+def stage_chunks(
+    chunks: Iterable[tuple[Any, int]],
+    *,
+    sharding: Any = None,
+    depth: int | None = None,
+) -> Iterator[tuple[Any, int, bool]]:
+    """Stage ``(host_chunk, valid_rows)`` pairs onto the device ahead of
+    consumption; yields ``(staged_array, valid_rows, owned)`` triples in
+    order, where ``owned`` marks a placement that actually created a new
+    device buffer (``device_put`` of an array already resident in the
+    right place returns the same object — such chunks belong to the
+    caller and must never be freed).
+
+    ``sharding`` is a ``jax.sharding.Sharding`` (or a callable mapping a
+    chunk to one, for rank-dependent specs) applied at ``device_put`` —
+    a sharded placement makes every downstream jitted call an SPMD
+    program over the mesh. ``None`` means plain single-device placement.
+
+    With ``depth > 0`` a daemon thread runs the placements so transfers
+    overlap the consumer's compute, at most ``depth`` staged chunks in
+    flight. ``depth=0`` (or ``KEYSTONE_STAGE_DEPTH=0``) stages inline on
+    the consumer thread — the fully synchronous reference behavior.
+    """
+    from keystone_tpu.observe import metrics as _metrics
+
+    depth = default_stage_depth() if depth is None else max(int(depth), 0)
+    reg = _metrics.get_registry()
+    sharded = sharding is not None
+    _emit_staging_event(depth=depth, sharded=sharded)
+
+    def place(chunk: Any, valid: int) -> tuple[Any, bool]:
+        spec = sharding(chunk) if callable(sharding) else sharding
+        staged = (
+            jax.device_put(chunk, spec)
+            if spec is not None
+            else jax.device_put(chunk)
+        )
+        owned = staged is not chunk
+        if owned:
+            # only placements that actually created a buffer count as
+            # transfers — device_put of an already-resident array moves
+            # nothing, and the counters must not claim PCIe traffic
+            reg.counter("plan_transfer_chunks").inc()
+            reg.counter("plan_transfer_bytes").inc(_nbytes(chunk))
+        pad = getattr(chunk, "shape", (valid,))[0] - valid
+        if pad > 0:
+            # total pad rows staged, whatever their cause (ragged tail,
+            # mesh rounding) — rows added purely by shard rounding are
+            # counted separately as plan_shard_pad_rows by the callers
+            # that do the rounding
+            reg.counter("plan_transfer_pad_rows").inc(pad)
+        if sharded:
+            reg.counter("plan_shard_chunks").inc()
+        return staged, owned
+
+    if depth == 0:
+
+        def inline() -> Iterator[tuple[Any, int, bool]]:
+            for chunk, valid in chunks:
+                staged, owned = place(chunk, valid)
+                yield staged, valid, owned
+
+        return inline()
+
+    reg.gauge("plan_transfer_stage_depth").set(depth)
+    q: _queue.Queue = _queue.Queue(maxsize=depth)
+    end = object()
+    stop = threading.Event()  # consumer gone — unblock + retire the thread
+
+    def put(item: Any) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.5)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def worker() -> None:
+        try:
+            for chunk, valid in chunks:
+                if stop.is_set():  # no placements after the consumer left
+                    return
+                staged, owned = place(chunk, valid)
+                if not put((staged, valid, owned)):
+                    if owned:
+                        free_buffers(staged)
+                    return
+            put(end)
+        except BaseException as e:  # noqa: BLE001 — re-raised at consumer
+            put(e)
+
+    thread = threading.Thread(target=worker, daemon=True)
+    thread.start()
+
+    def gen() -> Iterator[tuple[Any, int, bool]]:
+        # the finally runs on close()/GC of an abandoned generator, so
+        # the staging thread never stays parked in q.put holding staged
+        # device buffers, and chunks it already placed are freed — the
+        # join makes the drain see the worker's last in-flight put
+        try:
+            while True:
+                item = q.get()
+                if item is end:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+            thread.join(timeout=5.0)
+            try:
+                while True:
+                    item = q.get_nowait()
+                    if isinstance(item, tuple) and item[2]:
+                        free_buffers(item[0])
+            except _queue.Empty:
+                pass
+
+    return gen()
+
+
+def run_staged(
+    chunks: Iterable[tuple[Any, int]],
+    fn: Callable,
+    *,
+    sharding: Any = None,
+    stage_depth: int | None = None,
+    inflight: int = 2,
+    to_host: bool = False,
+    free_inputs: bool = True,
+) -> Iterator[Any]:
+    """Run ``fn`` over a staged chunk stream; yield each forced output
+    (pad rows sliced off) in order.
+
+    ``fn`` maps a staged chunk to a row-indexed array or pytree of
+    row-indexed arrays (every leaf's leading axis is rows — the contract
+    all three chunked call sites already required). Up to ``inflight``
+    results stay un-forced (``inflight=0`` forces each immediately);
+    forcing is ``np.asarray`` (device→host copy) when ``to_host``, else
+    ``block_until_ready`` on device. Once a result is forced, its dead
+    staged input is freed eagerly (``free_inputs``) — only buffers the
+    engine itself created are freed, and buffer-aliasing passthrough
+    outputs are detected and kept.
+    """
+    staged_iter = stage_chunks(chunks, sharding=sharding, depth=stage_depth)
+    pending: deque = deque()  # (staged, un-forced result, valid, owned)
+
+    def force(item: tuple[Any, Any, int, bool]) -> Any:
+        staged, out, valid, owned = item
+        if to_host:
+            forced = jax.tree_util.tree_map(
+                lambda a: np.asarray(a)[:valid], out
+            )
+        else:
+            out = jax.block_until_ready(out)
+            forced = jax.tree_util.tree_map(lambda a: a[:valid], out)
+        if free_inputs and owned:
+            free_buffers(staged, keep=(out, forced))
+        return forced
+
+    try:
+        for staged, valid, owned in staged_iter:
+            pending.append((staged, fn(staged), valid, owned))
+            while len(pending) > max(inflight, 0):
+                yield force(pending.popleft())
+        while pending:
+            yield force(pending.popleft())
+    finally:
+        close = getattr(staged_iter, "close", None)
+        if close is not None:
+            close()
+
+
+def _emit_staging_event(**fields: Any) -> None:
+    """One ``optimize`` event per staged stream when a run log is active
+    — the staging decision (depth, sharded) lands next to the planner's
+    rewrite/cache/chunk decisions in ``events.jsonl``."""
+    from keystone_tpu.observe import events as _events
+
+    log = _events.active()
+    if log is not None:
+        log.emit("optimize", source="staging", **fields)

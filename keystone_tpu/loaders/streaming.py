@@ -216,48 +216,65 @@ def featurize_stream(
     chunk_size: int,
     mesh=None,
     prefetch: int = 2,
+    stage_depth: int | None = None,
 ) -> np.ndarray:
     """Apply a jitted featurizer to a stream of host batches.
 
-    Every chunk is zero-padded to exactly ``chunk_size`` rows (pad rows
-    dropped from the output) so ONE compiled executable serves the whole
-    stream regardless of ragged batch sizes; with ``mesh`` each padded
-    chunk is placed data-sharded across the mesh before the call. Only
-    the (small) feature output accumulates on the host — peak memory is
-    one image chunk plus the features, never the corpus.
+    Every chunk is zero-padded to one static row count (``chunk_size``,
+    rounded up to a mesh-divisible shape when sharded; pad rows dropped
+    from the output) so ONE compiled executable serves the whole stream
+    regardless of ragged batch sizes; with ``mesh`` each padded chunk is
+    placed data-sharded across the mesh so the featurizer runs as one
+    SPMD program per chunk. Only the (small) feature output accumulates
+    on the host — peak memory is a bounded handful of chunks (staged +
+    in flight, see below) plus the features, never the corpus.
 
-    ``prefetch`` bounds in-flight device work: up to that many chunk
-    results stay un-forced, so the host moves on to decoding/padding the
-    next chunk while the device computes (JAX dispatch is async — it is
-    the ``np.asarray`` force that blocks). The producer side overlaps
-    too when the caller wraps its iterator in :func:`prefetch_batches`.
-    ``prefetch=0`` restores the fully synchronous round-trip. The pad
-    rule and the bounded-inflight drain are shared with
-    :func:`keystone_tpu.core.batching.apply_in_chunks`."""
-    from collections import deque
-
+    Execution routes through the shared staging engine
+    (:func:`keystone_tpu.core.staging.run_staged`): chunk k+1's
+    host→device transfer is double-buffered behind chunk k's compute
+    (``stage_depth`` / ``KEYSTONE_STAGE_DEPTH`` bounds the staged
+    depth), and ``prefetch`` bounds un-forced device results — it is the
+    ``np.asarray`` force that blocks, so the host moves on to
+    decoding/padding the next chunk while the device computes. The
+    producer side overlaps too when the caller wraps its iterator in
+    :func:`prefetch_batches`. Peak device residency is ``stage_depth``
+    staged chunks + ``prefetch`` un-forced results; ``prefetch=0``
+    forces each result before the next dispatch, and adding
+    ``stage_depth=0`` restores the fully synchronous one-chunk-at-a-time
+    reference behavior (no staging thread)."""
     from keystone_tpu.core.batching import pad_to_chunk
+    from keystone_tpu.core.staging import run_staged
 
-    outs = []
-    inflight: deque = deque()  # (device result, valid rows)
+    target = chunk_size
+    sharding = None
+    if mesh is not None:
+        from keystone_tpu.parallel.mesh import (
+            data_sharding_fn,
+            shard_chunk_size,
+        )
 
-    def drain(limit: int):
-        while len(inflight) > limit:
-            out, valid = inflight.popleft()
-            outs.append(np.asarray(out)[:valid])
+        target = shard_chunk_size(chunk_size, mesh)  # static + mesh-divisible
+        sharding = data_sharding_fn(mesh)
 
-    for batch in batches:
-        for start in range(0, len(batch), chunk_size):
-            chunk, valid = pad_to_chunk(
-                np.asarray(batch[start : start + chunk_size]), chunk_size
-            )
-            if mesh is not None:
-                from keystone_tpu.parallel.mesh import shard_batch
+    def chunks():
+        # step by the (mesh-rounded) target: fewer, fuller chunks than
+        # stepping by chunk_size and padding each up to target
+        for batch in batches:
+            for start in range(0, len(batch), target):
+                yield pad_to_chunk(
+                    np.asarray(batch[start : start + target]), target
+                )
 
-                chunk = shard_batch(chunk, mesh)
-            inflight.append((fn(chunk), valid))
-            drain(max(prefetch, 0))
-    drain(0)
+    outs = list(
+        run_staged(
+            chunks(),
+            fn,
+            sharding=sharding,
+            stage_depth=stage_depth,
+            inflight=prefetch,
+            to_host=True,
+        )
+    )
     if not outs:
         return np.zeros((0, 0), np.float32)
     return np.concatenate(outs, axis=0)

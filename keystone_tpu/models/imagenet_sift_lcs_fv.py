@@ -197,6 +197,7 @@ def run_streaming(
                 (sift_tail, lcs_tail),
                 np.asarray(imgs),
                 chunk_size=conf.chunk_size,
+                mesh=mesh,
             )
             res_sift.add(_descriptor_cols(sift_desc))
             res_lcs.add(_descriptor_cols(lcs_desc))

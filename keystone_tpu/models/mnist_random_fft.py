@@ -308,11 +308,13 @@ def run(conf: MnistRandomFFTConfig, mesh=None) -> dict:
         # KEYSTONE_PLAN: the test pass runs through the cost-based
         # planner's executor — one planned apply pipeline (featurizer
         # bank → block model → argmax), jitted segments, chunked with
-        # bounded in-flight dispatch when the plan says so. Predictions
-        # are identical to the block path; only the execution differs.
+        # bounded in-flight dispatch when the plan says so, and — with a
+        # mesh — dispatched data-sharded so the pass runs as one SPMD
+        # program per segment. Predictions are identical to the block
+        # path; only the execution differs.
         bank = FeaturizerBank(batches=tuple(tuple(g) for g in batch_featurizers))
         pred = plan_mod.execute(
-            Pipeline.of(bank, model, MaxClassifier()), test_x
+            Pipeline.of(bank, model, MaxClassifier()), test_x, mesh=mesh
         )
         errors["test"] = evaluator(pred, test_y, n_valid=n_test).error
         logger.info("test error (planned): %.2f%%", 100 * errors["test"])

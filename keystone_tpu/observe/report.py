@@ -43,6 +43,7 @@ def summarize(events: list[dict]) -> dict[str, Any]:
     compiles: dict[str, float] = {}
     phases: list[dict] = []
     spans: list[dict] = []
+    optimizes: list[dict] = []
     meta: dict[str, Any] = {"run": None, "wall_s": None, "status": None}
     for ev in events:
         kind = ev.get("event")
@@ -67,6 +68,8 @@ def summarize(events: list[dict]) -> dict[str, Any]:
             phases.append(ev)
         elif kind == "span":
             spans.append(ev)
+        elif kind == "optimize":
+            optimizes.append(ev)
         elif kind == "run_end":
             meta["wall_s"] = ev.get("wall_s")
             meta["status"] = ev.get("status")
@@ -76,6 +79,7 @@ def summarize(events: list[dict]) -> dict[str, Any]:
         "compiles": compiles,
         "phases": phases,
         "spans": spans,
+        "optimizes": optimizes,
     }
 
 
@@ -175,6 +179,27 @@ def render(run_dir: str) -> str:
                 f"  {str(ev.get('label', '?')):36} "
                 f"{ev.get('wall_s', 0.0):8.3f}s{status}"
             )
+        lines.append("")
+    if summary.get("optimizes"):
+        lines.append("optimizer decisions (planner / staging):")
+        for ev in summary["optimizes"]:
+            src = ev.get("source", "?")
+            decisions = ev.get("decisions")
+            if decisions:
+                for d in decisions:
+                    fields = ", ".join(
+                        f"{k}={v}" for k, v in d.items() if k != "action"
+                    )
+                    lines.append(
+                        f"  [{src}] {d.get('action', '?')}: {fields}"
+                    )
+            else:
+                fields = ", ".join(
+                    f"{k}={v}"
+                    for k, v in ev.items()
+                    if k not in ("event", "source", "ts", "run", "seq")
+                )
+                lines.append(f"  [{src}] {fields}")
         lines.append("")
     if peak is None and profiles:
         lines.append(

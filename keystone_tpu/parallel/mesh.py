@@ -133,6 +133,33 @@ def replicated_sharding(mesh: Mesh | None = None) -> NamedSharding | None:
     return NamedSharding(mesh, P())
 
 
+def data_sharding_fn(mesh: Mesh | None):
+    """Per-chunk placement callable for the staging engine: maps a chunk
+    to its rank-matched data-axis sharding (None mesh → None, plain
+    placement). The ONE home of the chunk→spec rule."""
+    if mesh is None:
+        return None
+    return lambda chunk: data_sharding(mesh, getattr(chunk, "ndim", 1))
+
+
+def data_axis_size(mesh: Mesh | None) -> int:
+    """Size of the "data" axis; 1 for no mesh or a mesh without one —
+    the ONE home of the shard-count read (planner, staging, bench)."""
+    if mesh is None:
+        return 1
+    try:
+        return int(dict(mesh.shape).get(DATA_AXIS, 1))
+    except Exception:  # noqa: BLE001 — foreign mesh-like object
+        return 1
+
+
+def shard_chunk_size(chunk_size: int, mesh: Mesh | None) -> int:
+    """``chunk_size`` rounded UP to a data-axis multiple, so a staged
+    chunk splits into even, static shard shapes."""
+    n = data_axis_size(mesh)
+    return -(-int(chunk_size) // n) * n
+
+
 def pad_batch(
     x: np.ndarray | jax.Array, multiple: int
 ) -> tuple[np.ndarray | jax.Array, int]:

@@ -23,8 +23,10 @@ Entry points::
 
 Env knobs: ``KEYSTONE_PLAN=1`` opts model entry points into planned
 execution; ``KEYSTONE_PLAN_BUDGET_MB`` caps resident cached
-intermediates (default 1024). Every decision is observable: ``optimize``
-events in the run log plus ``plan_*`` metrics counters.
+intermediates (default 1024); ``KEYSTONE_STAGE_DEPTH`` overrides the
+double-buffered host→device staging depth (0 = synchronous). Every
+decision is observable: ``optimize`` events in the run log plus
+``plan_*`` / ``plan_transfer_*`` / ``plan_shard_*`` metrics counters.
 """
 
 from __future__ import annotations
@@ -106,6 +108,8 @@ def plan_pipeline(
     chunk_size: int | None = None,
     n_rows: int | None = None,
     prefetch: int = 2,
+    mesh: Any = None,
+    stage_depth: int | None = None,
 ) -> Plan:
     """Build and optimize a plan for a fitted (apply) pipeline.
 
@@ -113,8 +117,14 @@ def plan_pipeline(
     doesn't already know (a bounded slice is taken — pass the real batch
     freely). ``chunk_size`` forces the executor's chunking; otherwise
     the planner picks one from cost estimates when ``n_rows`` (the
-    expected execution size) warrants it.
+    expected execution size) warrants it. ``mesh`` (default: the ambient
+    :func:`keystone_tpu.parallel.mesh.use_mesh` mesh) opts the executor
+    into data-axis sharded dispatch; the staging pass then also sizes
+    the double-buffered host→device transfer depth (``stage_depth`` /
+    ``KEYSTONE_STAGE_DEPTH`` override it).
     """
+    from keystone_tpu.parallel.mesh import current_mesh
+
     chain = chain_from(pipe)
     probe = _costs.slice_probe(sample) if sample is not None else None
     _costs.attach(chain, probe)
@@ -126,6 +136,7 @@ def plan_pipeline(
         device_kind=_device_kind(),
         rows=_costs._rows(probe) if probe is not None else 0,
         prefetch=prefetch,
+        mesh=mesh if mesh is not None else current_mesh(),
     )
     _passes.select_operators(plan)
     # budget decisions are priced at the REAL execution size, not the
@@ -133,10 +144,17 @@ def plan_pipeline(
     _passes.choose_materialization(plan, rows=n_rows)
     if chunk_size is not None or n_rows is not None:
         _passes.choose_chunk_size(
-            plan, n_rows or 0, requested=chunk_size
+            plan, n_rows or 0, requested=chunk_size, shards=_shards(plan)
         )
+    _passes.choose_staging(plan, n_rows or 0, requested_depth=stage_depth)
     _passes.emit_plan(plan)
     return plan
+
+
+def _shards(plan: Plan) -> int:
+    from keystone_tpu.parallel.mesh import data_axis_size
+
+    return data_axis_size(plan.mesh)
 
 
 def execute(
@@ -147,9 +165,12 @@ def execute(
     budget_bytes: int | None = None,
     chunk_size: int | None = None,
     prefetch: int = 2,
+    mesh: Any = None,
+    stage_depth: int | None = None,
 ) -> Any:
     """One-shot planned execution: plan ``pipe`` (sampling costs on a
-    slice of ``data`` unless a separate ``sample`` is given) and run it."""
+    slice of ``data`` unless a separate ``sample`` is given) and run it —
+    sharded over ``mesh``'s data axis when one is given/installed."""
     plan = plan_pipeline(
         pipe,
         sample=data if sample is None else sample,
@@ -157,6 +178,8 @@ def execute(
         chunk_size=chunk_size,
         n_rows=_costs._rows(data),
         prefetch=prefetch,
+        mesh=mesh,
+        stage_depth=stage_depth,
     )
     return run_plan(plan, data)
 

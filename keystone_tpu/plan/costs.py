@@ -61,6 +61,8 @@ def cost_from_profile(profile: dict, rows: int) -> NodeCost:
         bytes_accessed=float(profile.get("bytes_accessed", 0.0)) / rows,
         output_bytes=float(profile.get("output_bytes", 0.0)) / rows,
         peak_bytes=float(profile.get("peak_bytes", 0.0)) / rows,
+        input_bytes=float(profile.get("input_bytes", 0.0)) / rows,
+        collective_bytes=float(profile.get("collective_bytes", 0.0)) / rows,
         source="profile",
     )
 
@@ -115,6 +117,7 @@ def sample_chain(chain: list[PlanNode], probe: Any) -> Any:
             except Exception:  # noqa: BLE001 — can't feed further nodes
                 return probe
             continue
+        in_bytes = _out_bytes(probe) / rows
         try:
             profile = _cost.analyze(lambda n, b: n(b), pn.op, probe)
             t0 = time.perf_counter()
@@ -127,6 +130,10 @@ def sample_chain(chain: list[PlanNode], probe: Any) -> Any:
         pn.cost.source = "sampled"
         if not pn.cost.output_bytes:
             pn.cost.output_bytes = _out_bytes(out) / rows
+        # the node's input is the probe it just consumed — for the
+        # chain's first node that is the host batch crossing PCIe, the
+        # basis of the staging pass's transfer-vs-compute comparison
+        pn.cost.input_bytes = in_bytes
         probe = out
     return probe
 

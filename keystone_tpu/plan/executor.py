@@ -9,8 +9,14 @@
   executions hit the same executables),
 - when the plan chose a chunk size, a segment streams through
   :func:`keystone_tpu.core.batching.apply_in_chunks` with bounded
-  in-flight dispatch — the ``featurize_stream`` idiom promoted into the
-  core execution path,
+  in-flight dispatch and double-buffered host→device staging (the
+  shared :mod:`keystone_tpu.core.staging` engine) — the
+  ``featurize_stream`` idiom promoted into the core execution path,
+- when the plan chose sharded dispatch (a mesh with >1 slot on the
+  ``"data"`` axis), the input batch — or each staged chunk — is placed
+  data-sharded across the mesh, so every jitted segment runs as ONE
+  SPMD program and a planned pass scales with chip count the way the
+  sharded solvers already do,
 - at each materialization point the intermediate is forced resident
   (``block_until_ready`` — the ``Cacher`` semantic), and the *previous*
   segment's dead intermediate is freed eagerly so peak residency is one
@@ -32,6 +38,7 @@ import jax
 import numpy as np
 
 from keystone_tpu.core.batching import apply_in_chunks, pad_to_chunk
+from keystone_tpu.core.staging import free_buffers, run_staged
 from keystone_tpu.core.pipeline import (
     Cacher,
     ChainedEstimator,
@@ -92,15 +99,77 @@ def _segments(chain: list[PlanNode]) -> list[list[PlanNode]]:
 
 def _free(tree: Any, keep: Any) -> None:
     """Eagerly release a dead intermediate's device buffers. ``keep``
-    leaves are never deleted (an aliasing no-op segment could hand the
-    same Array straight through)."""
-    keep_ids = {id(leaf) for leaf in jax.tree_util.tree_leaves(keep)}
-    for leaf in jax.tree_util.tree_leaves(tree):
-        if isinstance(leaf, jax.Array) and id(leaf) not in keep_ids:
-            try:
-                leaf.delete()
-            except Exception:  # noqa: BLE001 — committed/donated buffer
-                pass
+    leaves — by identity or by shared buffer (an aliasing no-op segment
+    can hand the same buffer straight through) — are never deleted.
+    One home: :func:`keystone_tpu.core.staging.free_buffers`."""
+    free_buffers(tree, keep=keep)
+
+
+def _data_sharding(plan: Plan):
+    """The per-chunk placement callable for a plan that chose sharded
+    dispatch, else None."""
+    if not plan.shard or plan.mesh is None:
+        return None
+    from keystone_tpu.parallel.mesh import data_sharding_fn
+
+    return data_sharding_fn(plan.mesh)
+
+
+def _stage_input(plan: Plan, data: Any) -> tuple[Any, int | None, bool]:
+    """Place the whole input batch data-sharded across the plan's mesh.
+
+    Returns ``(placed, n_valid, owned)``: ``n_valid`` is the original
+    row count when pad rows were added (the caller trims the final
+    output back), else None; ``owned`` marks a planner-created placement
+    whose buffers may be freed once the first segment consumed it.
+
+    Padding is only legal when every node is row-wise (the
+    ``_chunkable_node`` contract — a whole-dataset ``FunctionNode``
+    would see the pad rows); an indivisible batch over a chain that
+    isn't row-wise stays unsharded, with the refusal counted.
+    """
+    reg = _metrics.get_registry()
+    if not plan.shard or plan.mesh is None:
+        return data, None, False
+    if not isinstance(data, (np.ndarray, jax.Array)) or data.ndim < 1:
+        return data, None, False
+    from keystone_tpu.parallel.mesh import (
+        data_axis_size,
+        data_sharding,
+        pad_batch,
+    )
+
+    n_data = data_axis_size(plan.mesh)
+    n = data.shape[0]
+    placed = data
+    n_valid = None
+    if n % n_data:
+        chains = [plan.prefix, *plan.branches]
+        if not all(
+            _chunkable_node(pn.op) for chain in chains for pn in chain
+        ):
+            reg.counter("plan_shard_refused").inc()
+            return data, None, False
+        placed, n_valid = pad_batch(data, n_data)
+        reg.counter("plan_shard_pad_rows").inc(placed.shape[0] - n)
+    staged = jax.device_put(placed, data_sharding(plan.mesh, placed.ndim))
+    reg.counter("plan_shard_dispatches").inc()
+    if staged is not placed:
+        # an already-resident, already-sharded batch moves nothing — the
+        # transfer counters only claim traffic that happened
+        reg.counter("plan_transfer_chunks").inc()
+        reg.counter("plan_transfer_bytes").inc(
+            int(getattr(placed, "nbytes", 0))
+        )
+    return staged, n_valid, staged is not data
+
+
+def _trim(out: Any, n_valid: int | None) -> Any:
+    """Drop shard-pad rows from a final output (every leaf row-indexed —
+    guaranteed by the row-wise gate in :func:`_stage_input`)."""
+    if n_valid is None:
+        return out
+    return jax.tree_util.tree_map(lambda a: a[:n_valid], out)
 
 
 def _run_chain(
@@ -126,13 +195,26 @@ def _run_chain(
         else:
             chunk_ok = False
         if chunk_ok:
+            from keystone_tpu.parallel.mesh import data_axis_size
+
+            sharding = _data_sharding(plan)
+            shards = data_axis_size(plan.mesh)
+            # a chunk that doesn't divide over the data axis can't form
+            # even shard shapes — the planner rounds, this guards
+            if sharding is not None and plan.chunk_size % shards:
+                sharding = None
             out = apply_in_chunks(
                 lambda b, p=seg_pipe: jit_apply(p, b),
                 out,
                 plan.chunk_size,
                 inflight=max(plan.prefetch, 0),
+                sharding=sharding,
+                stage_depth=plan.stage_depth,
+                shard_multiple=shards if sharding is not None else None,
             )
             reg.counter("plan_chunked_executions").inc()
+            if sharding is not None:
+                reg.counter("plan_shard_dispatches").inc()
         else:
             out = jit_apply(seg_pipe, out)
         if seg[-1].materialize or isinstance(seg[-1].op, Cacher):
@@ -146,22 +228,42 @@ def _run_chain(
 
 def run_plan(plan: Plan, data: Any) -> Any:
     """Execute a plan on ``data``. Single-chain plans return the chain
-    output; multi-branch plans return one output per branch."""
+    output; multi-branch plans return one output per branch.
+
+    When the plan chose sharded dispatch and no chunking, the whole
+    batch is placed data-sharded up front (chunked plans shard each
+    staged chunk instead — see :func:`_run_chain`); shard-pad rows are
+    trimmed from the final output.
+    """
+    n_valid, owned = None, False
+    if plan.chunk_size is None:
+        data, n_valid, owned = _stage_input(plan, data)
     if not plan.branches:
-        return _run_chain(plan.prefix, data, plan)
+        return _trim(
+            _run_chain(plan.prefix, data, plan, own_input=owned), n_valid
+        )
     reg = _metrics.get_registry()
     if plan.share_prefix and plan.prefix:
-        feats = jax.block_until_ready(_run_chain(plan.prefix, data, plan))
+        feats = jax.block_until_ready(
+            _run_chain(plan.prefix, data, plan, own_input=owned)
+        )
         # per-call unit (see apply_shared): corpus-level passes-saved
         # accounting belongs to the caller that knows the corpus
         reg.counter("plan_shared_prefix_applies").inc()
-        outs = [_run_chain(b, feats, plan) for b in plan.branches]
+        outs = [
+            _trim(_run_chain(b, feats, plan), n_valid)
+            for b in plan.branches
+        ]
         _free(feats, keep=outs)
         return outs
-    return [
-        _run_chain(plan.prefix + branch, data, plan)
+    outs = [
+        _trim(_run_chain(plan.prefix + branch, data, plan), n_valid)
         for branch in plan.branches
     ]
+    if owned:
+        # the staged placement fed every branch; it is dead only now
+        _free(data, keep=outs)
+    return outs
 
 
 def fit_shared(
@@ -267,44 +369,60 @@ def apply_shared(
     chunk_size: int,
     inflight: int = 2,
     to_host: bool = False,
+    mesh: Any = None,
+    stage_depth: int | None = None,
 ) -> list:
     """Chunked shared-prefix apply: for each fixed-size chunk, run
     ``prefix_fn`` ONCE and feed its output to every branch — the
     per-chunk form of prefix sharing for streaming passes whose shared
     intermediate must never materialize corpus-wide (e.g. pixel-scaled
     images feeding both the SIFT and LCS descriptor branches). Returns
-    one concatenated output per branch; bounded in-flight dispatch as in
-    :func:`keystone_tpu.core.batching.apply_in_chunks`."""
-    from collections import deque
+    one concatenated output per branch.
 
+    Chunks route through the shared staging engine
+    (:func:`keystone_tpu.core.staging.run_staged`): double-buffered
+    host→device transfers, bounded in-flight dispatch as in
+    :func:`keystone_tpu.core.batching.apply_in_chunks`, and — with a
+    ``mesh`` — data-sharded placement so prefix and branches run as one
+    SPMD program per chunk."""
     reg = _metrics.get_registry()
-    outs: list[list] = [[] for _ in branch_fns]
-    pending: list[deque] = [deque() for _ in branch_fns]
+    target = chunk_size
+    sharding = None
+    if mesh is not None:
+        from keystone_tpu.parallel.mesh import (
+            data_sharding_fn,
+            shard_chunk_size,
+        )
 
-    def drain(limit: int):
-        for j, q in enumerate(pending):
-            while len(q) > limit:
-                out, valid = q.popleft()
-                outs[j].append(
-                    np.asarray(out)[:valid]
-                    if to_host
-                    else jax.block_until_ready(out)[:valid]
-                )
+        target = shard_chunk_size(chunk_size, mesh)
+        sharding = data_sharding_fn(mesh)
 
-    n = data.shape[0]
-    for start in range(0, n, chunk_size):
-        chunk, valid = pad_to_chunk(data[start : start + chunk_size], chunk_size)
+    def chunks():
+        # step by the (mesh-rounded) target — see featurize_stream
+        for start in range(0, data.shape[0], target):
+            yield pad_to_chunk(data[start : start + target], target)
+
+    def all_branches(chunk):
         shared = prefix_fn(chunk)
-        for j, fn in enumerate(branch_fns):
-            pending[j].append((fn(shared), valid))
-        drain(max(inflight, 0))
-    drain(0)
+        return tuple(fn(shared) for fn in branch_fns)
+
+    per_chunk = list(
+        run_staged(
+            chunks(),
+            all_branches,
+            sharding=sharding,
+            stage_depth=stage_depth,
+            inflight=inflight,
+            to_host=to_host,
+        )
+    )
     if len(branch_fns) > 1:
         # per-call unit is "chunked applies that shared a prefix" — the
         # corpus-level passes-saved accounting belongs to the CALLER
         # (one stream = one saved pass, however many batches it took),
         # so a batch loop can't inflate the headline counter
         reg.counter("plan_shared_prefix_applies").inc()
+    outs = [[chunk[j] for chunk in per_chunk] for j in range(len(branch_fns))]
     if to_host:
         return [np.concatenate(o, axis=0) for o in outs]
     import jax.numpy as jnp

@@ -24,19 +24,23 @@ from keystone_tpu.core.pipeline import Cacher, Pipeline, Transformer
 from keystone_tpu.observe import events as _events
 
 # Roofline constants used to turn a compiler cost profile into seconds
-# when no measured wall time exists: (peak FLOP/s, peak bytes/s) per
-# device kind. Deliberately coarse — the planner compares operators
-# against each other and against a residency penalty, so only relative
-# magnitudes matter. Unknown device kinds fall back to "cpu".
-DEVICE_PEAKS: dict[str, tuple[float, float]] = {
-    "cpu": (5e10, 2e10),
-    "TPU v4": (2.75e14, 1.2e12),
-    "TPU v5 lite": (3.94e14, 8.1e11),
-    "TPU v5e": (3.94e14, 8.1e11),
+# when no measured wall time exists: (peak FLOP/s, peak HBM bytes/s,
+# host→device bytes/s over PCIe, collective bytes/s over ICI) per device
+# kind. Deliberately coarse — the planner compares operators against
+# each other and against residency/transfer penalties, so only relative
+# magnitudes matter. Unknown device kinds fall back to "cpu" (whose
+# "transfer" is a host memcpy and "ICI" a NUMA hop — same order as HBM).
+DEVICE_PEAKS: dict[str, tuple[float, float, float, float]] = {
+    "cpu": (5e10, 2e10, 2e10, 2e10),
+    "TPU v4": (2.75e14, 1.2e12, 3.2e10, 3e11),
+    "TPU v5 lite": (3.94e14, 8.1e11, 3.2e10, 1.6e11),
+    "TPU v5e": (3.94e14, 8.1e11, 3.2e10, 1.6e11),
 }
 
 
-def device_peaks(device_kind: str | None) -> tuple[float, float]:
+def device_peaks(
+    device_kind: str | None,
+) -> tuple[float, float, float, float]:
     if device_kind:
         for kind, peaks in DEVICE_PEAKS.items():
             if kind.lower() in device_kind.lower():
@@ -55,12 +59,21 @@ class NodeCost:
     records where the numbers came from (``profile`` — the observe cost
     registry; ``sampled`` — a fresh profiling pass; ``default`` — no
     information, conservative zeros).
+
+    The comms terms: ``input_bytes`` is what the node reads from its
+    predecessor — for the chain's FIRST node that is the host batch that
+    must cross PCIe per chunk; ``collective_bytes`` is what a sharded
+    execution of the node moves over ICI in collectives (``psum`` of
+    partial products etc. — zero for purely row-wise maps, which need
+    no cross-shard communication at all).
     """
 
     flops: float = 0.0
     bytes_accessed: float = 0.0
     output_bytes: float = 0.0
     peak_bytes: float = 0.0
+    input_bytes: float = 0.0
+    collective_bytes: float = 0.0
     wall_s: float | None = None
     source: str = "default"
 
@@ -68,11 +81,26 @@ class NodeCost:
         """Estimated seconds to (re)compute this node over ``rows`` rows."""
         if self.wall_s is not None:
             return self.wall_s * rows
-        peak_flops, peak_bw = device_peaks(device_kind)
+        peak_flops, peak_bw, _, _ = device_peaks(device_kind)
         return max(
             self.flops * rows / peak_flops,
             self.bytes_accessed * rows / peak_bw,
         )
+
+    def h2d_s(self, rows: float, device_kind: str | None = None) -> float:
+        """Estimated seconds to move this node's input host→device
+        (PCIe) for ``rows`` rows — the staging transfer the executor
+        tries to hide behind compute."""
+        _, _, h2d_bw, _ = device_peaks(device_kind)
+        return self.input_bytes * rows / h2d_bw
+
+    def collective_s(
+        self, rows: float, device_kind: str | None = None
+    ) -> float:
+        """Estimated seconds this node spends in cross-shard collectives
+        (ICI psum) when executed sharded over ``rows`` rows."""
+        _, _, _, ici_bw = device_peaks(device_kind)
+        return self.collective_bytes * rows / ici_bw
 
 
 @dataclasses.dataclass
@@ -106,6 +134,9 @@ class Plan:
     budget_bytes: int = 0
     device_kind: str | None = None
     rows: int = 0  # rows the costs were normalized against (sample size)
+    mesh: Any = None  # jax Mesh for sharded dispatch (None — single device)
+    shard: bool = False  # planner chose data-axis sharded dispatch
+    stage_depth: int = 2  # staged host→device chunks kept in flight
     decisions: list[dict] = dataclasses.field(default_factory=list)
 
     def decide(self, action: str, **fields: Any) -> dict:
@@ -138,7 +169,13 @@ class Plan:
             + (f" + {len(self.branches)} branch(es)" if self.branches else ""),
             f"  budget: {self.budget_bytes / 2**20:.0f} MiB"
             + (f"  chunk: {self.chunk_size}" if self.chunk_size else "  chunk: -")
-            + f"  device: {self.device_kind or 'unknown'}",
+            + f"  device: {self.device_kind or 'unknown'}"
+            + (
+                f"  shard: {dict(self.mesh.shape).get('data', '?')}x data"
+                if self.shard and self.mesh is not None
+                else ""
+            )
+            + f"  stage_depth: {self.stage_depth}",
             f"  {'#':>2} {'node':<28} {'flops/row':>10} {'out B/row':>10}"
             f" {'est s':>9} {'reuse':>5} {'cache':>5}",
         ]

@@ -23,6 +23,8 @@ user pipelines in place.
 from __future__ import annotations
 
 import dataclasses
+import math
+import os
 from typing import Any, Callable, Sequence
 
 from keystone_tpu.observe import events as _events
@@ -127,6 +129,8 @@ def _rewrite_chain(
             bytes_accessed=sum(pn.cost.bytes_accessed for pn in window),
             output_bytes=window[-1].cost.output_bytes,
             peak_bytes=max(pn.cost.peak_bytes for pn in window),
+            input_bytes=window[0].cost.input_bytes,
+            collective_bytes=sum(pn.cost.collective_bytes for pn in window),
             wall_s=(
                 sum(pn.cost.wall_s or 0.0 for pn in window)
                 if any(pn.cost.wall_s is not None for pn in window)
@@ -174,7 +178,11 @@ def choose_materialization(plan: Plan, rows: int | None = None) -> Plan:
     reg = _metrics.get_registry()
     # benefit of caching node i = (reuse − 1) × recomputing the WHOLE
     # upstream chain through i: without the cache, every extra consumer
-    # pays the prefix again from the source, not just the tail node
+    # pays the prefix again from the source, not just the tail node.
+    # (No h2d term here: the unchunked executor stages the source batch
+    # ONCE and reuses it across consumers, and this pass runs before the
+    # chunking decision, so charging re-staging per consumer would
+    # overstate the benefit of borderline cache points.)
     cumulative: dict[int, float] = {}
     running, any_costed = 0.0, False
     for pn in plan.prefix:
@@ -240,11 +248,19 @@ def choose_chunk_size(
     *,
     requested: int | None = None,
     budget_fraction: float = 0.25,
+    shards: int = 1,
 ) -> Plan:
     """Operator selection for the chunked executor: bound the per-chunk
     working set to ``budget_fraction`` of the memory budget using the
     chain's worst per-row peak bytes; chunk sizes are powers of two so
-    repeated plans hit the same compiled executables."""
+    repeated plans hit the same compiled executables.
+
+    ``shards`` (the mesh data-axis size) scales the bound: a sharded
+    chunk splits its working set over the shards, so the per-DEVICE
+    budget admits ``shards``× more rows per dispatch — and the chosen
+    size is kept divisible by ``shards`` so every shard gets an even,
+    static shape.
+    """
     if requested is not None:
         plan.chunk_size = requested
         plan.decide("chunk", size=requested, source="requested")
@@ -259,18 +275,121 @@ def choose_chunk_size(
     )
     if peak_row <= 0.0 or plan.budget_bytes <= 0:
         return plan  # no basis for a choice — executor stays unchunked
-    limit = max(int(plan.budget_bytes * budget_fraction / peak_row), 1)
+    shards = max(int(shards), 1)
+    limit = max(
+        int(plan.budget_bytes * budget_fraction * shards / peak_row), 1
+    )
     if limit >= n_rows:
         plan.decide("chunk", size=None, reason="fits_whole_batch")
         return plan
     size = 1 << max(limit.bit_length() - 1, 0)
+    if shards > 1:
+        # even static shard shapes: divisible by the data-axis size
+        # (power-of-two meshes divide power-of-two chunks for free)
+        size = max(size - size % shards, shards)
     plan.chunk_size = size
     plan.decide(
         "chunk",
         size=size,
         peak_bytes_per_row=int(peak_row),
         budget_bytes=plan.budget_bytes,
+        shards=shards,
     )
+    return plan
+
+
+def choose_staging(
+    plan: Plan,
+    n_rows: int,
+    *,
+    mesh: Any = None,
+    requested_depth: int | None = None,
+) -> Plan:
+    """Comms-aware staging + sharding decisions (the transfer half of the
+    cost model — KeystoneML priced network shuffles; the TPU analog is
+    PCIe host→device staging and ICI collectives):
+
+    - **stage depth** — how many host→device chunk transfers to keep in
+      flight ahead of compute. Double-buffering (2) hides the transfer
+      entirely when per-chunk transfer time ≤ per-chunk compute time;
+      a transfer-bound chain gets proportionally deeper staging (≤ 4 —
+      beyond that the pipe is PCIe-limited and depth only adds
+      residency). ``KEYSTONE_STAGE_DEPTH``/``requested_depth`` override.
+    - **sharded dispatch** — split chunks over the mesh ``"data"`` axis
+      when a mesh with more than one data slot is attached: per-shard
+      compute divides by the shard count while the (row-wise) chains
+      this executor runs add no collective traffic; chains with a
+      collective term have it priced against ICI bandwidth and recorded
+      in the decision.
+
+    Every decision lands in ``plan.decisions`` (→ one ``optimize`` event
+    via :func:`emit_plan`) and the ``plan_*`` counters.
+    """
+    from keystone_tpu.core.staging import default_stage_depth
+
+    reg = _metrics.get_registry()
+    mesh = mesh if mesh is not None else plan.mesh
+    plan.mesh = mesh
+    chunk_rows = plan.chunk_size or max(n_rows, plan.rows, 1)
+
+    chains = [plan.prefix, *plan.branches]
+    compute_s = sum(
+        pn.cost.recompute_s(chunk_rows, plan.device_kind)
+        for chain in chains
+        for pn in chain
+    )
+    transfer_s = (
+        plan.prefix[0].cost.h2d_s(chunk_rows, plan.device_kind)
+        if plan.prefix
+        else 0.0
+    )
+    collective_s = sum(
+        pn.cost.collective_s(chunk_rows, plan.device_kind)
+        for chain in chains
+        for pn in chain
+    )
+
+    if requested_depth is not None:
+        depth, source = max(int(requested_depth), 0), "requested"
+    elif os.environ.get("KEYSTONE_STAGE_DEPTH", "").strip():
+        depth, source = default_stage_depth(), "env"
+    elif transfer_s > 0.0 and compute_s > 0.0 and transfer_s > compute_s:
+        # transfer-bound: stage enough chunks that the device never
+        # starves while a transfer completes (ratio + 1, capped)
+        depth = min(4, math.ceil(transfer_s / compute_s) + 1)
+        source = "cost_model"
+    else:
+        depth, source = 2, "cost_model"  # compute-bound: double buffer
+    plan.stage_depth = depth
+    plan.decide(
+        "stage",
+        depth=depth,
+        source=source,
+        transfer_s_per_chunk=round(transfer_s, 9),
+        compute_s_per_chunk=round(compute_s, 9),
+        hidden=bool(transfer_s <= compute_s),
+    )
+    reg.counter("plan_stage_decisions").inc()
+
+    from keystone_tpu.parallel.mesh import data_axis_size, shard_chunk_size
+
+    shards = data_axis_size(mesh)
+    if shards > 1:
+        if plan.chunk_size and plan.chunk_size % shards:
+            # round the chunk UP to a shard multiple: same number of
+            # executions, even static shard shapes
+            plan.chunk_size = shard_chunk_size(plan.chunk_size, mesh)
+        plan.shard = True
+        plan.decide(
+            "shard",
+            shards=shards,
+            axis="data",
+            chunk_size=plan.chunk_size,
+            collective_s_per_chunk=round(collective_s, 9),
+        )
+        reg.counter("plan_shard_planned").inc()
+    else:
+        plan.shard = False
     return plan
 
 

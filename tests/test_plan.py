@@ -499,6 +499,294 @@ def test_pad_to_chunk_shared_helper():
 
 
 # ---------------------------------------------------------------------------
+# staging engine: bounded depth, error propagation, clean shutdown
+
+
+def test_staging_engine_bounded_stage_depth():
+    """The staging thread runs AHEAD of the consumer but never further
+    than its bound: consumed results + inflight dispatches + staged
+    queue + one chunk in the producer's hand."""
+    import time
+
+    from keystone_tpu.core.staging import run_staged
+
+    produced = []
+
+    def chunks():
+        for i in range(50):
+            produced.append(i)
+            yield np.full((4, 2), float(i), np.float32), 4
+
+    fn = jax.jit(lambda b: b + 1.0)
+    it = run_staged(chunks(), fn, stage_depth=2, inflight=1)
+    try:
+        first = next(it)
+        np.testing.assert_array_equal(np.asarray(first), 1.0)
+        deadline = time.monotonic() + 2.0
+        stable = len(produced)
+        while time.monotonic() < deadline:
+            time.sleep(0.05)
+            if len(produced) == stable:
+                break
+            stable = len(produced)
+        # 2 consumed by the drain + 1 yielded-pending + depth 2 staged
+        # + 1 in the producer's hand (+1 slack for the put/pull race)
+        assert len(produced) <= 7, produced
+    finally:
+        it.close()
+
+
+def test_staging_engine_producer_error_propagates():
+    from keystone_tpu.core.staging import run_staged
+
+    def chunks():
+        yield np.ones((4, 2), np.float32), 4
+        raise RuntimeError("stage source exploded")
+
+    it = run_staged(chunks(), jax.jit(lambda b: b * 2.0), stage_depth=2)
+    with pytest.raises(RuntimeError, match="stage source exploded"):
+        list(it)
+
+
+def test_staging_engine_clean_shutdown_on_close():
+    """Closing the consumer mid-stream retires the staging thread and
+    stops the chunk source instead of draining it."""
+    import threading
+    import time
+
+    from keystone_tpu.core.staging import run_staged
+
+    produced = []
+
+    def chunks():
+        for i in range(200):
+            produced.append(i)
+            yield np.zeros((4, 2), np.float32), 4
+
+    before = threading.active_count()
+    it = run_staged(chunks(), jax.jit(lambda b: b + 1.0), stage_depth=1)
+    next(it)
+    it.close()
+    deadline = time.monotonic() + 5.0
+    while threading.active_count() > before and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before, "staging thread leaked"
+    assert len(produced) < 200, "source should stop early, not drain"
+
+
+def test_staging_engine_passthrough_alias_safe():
+    """A passthrough fn can alias its staged input into the output; the
+    eager input-free must detect the shared buffer and keep it."""
+    from keystone_tpu.core.staging import run_staged
+
+    fn = jax.jit(lambda b: b)
+    chunks = [(np.full((4, 2), float(i), np.float32), 4) for i in range(5)]
+    outs = list(run_staged(iter(chunks), fn, stage_depth=0, inflight=0))
+    for i, out in enumerate(outs):
+        np.testing.assert_array_equal(np.asarray(out), float(i))
+
+
+def test_all_drain_loops_route_through_staging_engine(rng):
+    """apply_in_chunks, featurize_stream, and apply_shared all stage
+    through the ONE engine — every chunk shows up in the shared
+    plan_transfer_chunks counter."""
+    from keystone_tpu.core.batching import apply_in_chunks
+    from keystone_tpu.loaders.streaming import featurize_stream
+
+    fn = jax.jit(lambda b: b * 2.0)
+    x = rng.normal(size=(64, 4)).astype(np.float32)
+    before = _counter("plan_transfer_chunks")
+    apply_in_chunks(fn, x, 16)  # 4 chunks
+    featurize_stream(iter([x]), fn, chunk_size=16)  # 4 chunks
+    plan_mod.apply_shared(
+        jax.jit(lambda b: b + 1.0), (fn,), x, chunk_size=16
+    )  # 4 chunks
+    assert _counter("plan_transfer_chunks") - before == 12
+
+
+# ---------------------------------------------------------------------------
+# sharded planned execution: bit-exact vs single-device naive
+
+
+def test_sharded_planned_execution_bit_exact_mnist(rng, mesh8):
+    """Planned execution dispatched data-sharded over the 8-way mesh —
+    whole-batch SPMD and chunked (each staged chunk sharded) — is
+    bit-exact vs the naive single-device apply, and the staging engine's
+    transfer/shard metrics record the dispatch."""
+    from keystone_tpu.models.mnist_random_fft import FeaturizerBank
+    from keystone_tpu.ops.linear import BlockLeastSquaresEstimator
+    from keystone_tpu.ops.util import ClassLabelIndicators, MaxClassifier
+
+    x = jnp.asarray(rng.normal(size=(256, 784)).astype(np.float32))
+    y = ClassLabelIndicators(num_classes=10)(
+        rng.integers(0, 10, size=256).astype(np.int32)
+    )
+    bank = FeaturizerBank.create(2, 1024, seed=0)
+    model = BlockLeastSquaresEstimator(block_size=1024, num_iter=1, lam=1.0).fit(
+        bank(x), y
+    )
+    pipe = Pipeline.of(bank, model, MaxClassifier())
+    naive = np.asarray(pipe(x))
+
+    dispatches_before = _counter("plan_shard_dispatches")
+    got = plan_mod.execute(pipe, x, mesh=mesh8)
+    np.testing.assert_array_equal(np.asarray(got), naive)
+    assert _counter("plan_shard_dispatches") > dispatches_before
+
+    chunks_before = _counter("plan_shard_chunks")
+    transfer_before = _counter("plan_transfer_chunks")
+    got_chunked = plan_mod.execute(pipe, x, chunk_size=64, mesh=mesh8)
+    np.testing.assert_array_equal(np.asarray(got_chunked), naive)
+    assert _counter("plan_shard_chunks") - chunks_before >= 4
+    assert _counter("plan_transfer_chunks") - transfer_before >= 4
+
+
+def test_sharded_planned_execution_bit_exact_cifar(rng, mesh8):
+    """The CIFAR conv chain sharded over the mesh (18 images do NOT
+    divide over 8 slots — the executor pads, runs SPMD, trims) matches
+    the production fused path bit for bit."""
+    from keystone_tpu.core.fusion import optimize
+    from keystone_tpu.ops.images import (
+        Convolver,
+        ImageVectorizer,
+        Pooler,
+        SymmetricRectifier,
+    )
+
+    k, f = 6, 16
+    d = k * k * 3
+    pipe = (
+        Convolver(
+            filters=jnp.asarray(rng.normal(size=(f, d)).astype(np.float32)),
+            whitener_means=jnp.asarray(rng.normal(size=(d,)).astype(np.float32)),
+            patch_size=k,
+            normalize_patches=True,
+        )
+        >> SymmetricRectifier(alpha=0.25)
+        >> Pooler(stride=13, pool_size=14)
+        >> ImageVectorizer()
+    )
+    x = jnp.asarray(rng.normal(size=(18, 32, 32, 3)).astype(np.float32))
+    naive = np.asarray(jit_apply(optimize(pipe), x))
+    pad_before = _counter("plan_shard_pad_rows")
+    got = plan_mod.execute(pipe, x, mesh=mesh8)
+    assert np.asarray(got).shape == naive.shape  # pad rows trimmed
+    np.testing.assert_array_equal(np.asarray(got), naive)
+    assert _counter("plan_shard_pad_rows") - pad_before == 6  # 18 → 24
+
+
+def test_apply_in_chunks_sharded_matches(rng, mesh8):
+    from keystone_tpu.core.batching import apply_in_chunks
+    from keystone_tpu.parallel.mesh import data_sharding
+
+    fn = jax.jit(lambda b: b * 2.0 + 1.0)
+    data = jnp.asarray(rng.normal(size=(70, 6)).astype(np.float32))
+    want = np.asarray(fn(data))
+    got = apply_in_chunks(
+        fn, data, 16, sharding=lambda c: data_sharding(mesh8, c.ndim)
+    )
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+
+
+def test_mnist_run_planned_sharded_matches_naive(rng, monkeypatch, mesh8):
+    """End to end: KEYSTONE_PLAN + an 8-way mesh routes the MNIST test
+    pass through sharded planned execution; measured errors match the
+    naive mesh run exactly."""
+    from keystone_tpu.models import mnist_random_fft as m
+
+    conf = m.MnistRandomFFTConfig(
+        synthetic=128, num_ffts=1, block_size=512, lam=10.0
+    )
+    monkeypatch.delenv(plan_mod.ENV_ENABLE, raising=False)
+    naive = m.run(conf, mesh=mesh8)
+    monkeypatch.setenv(plan_mod.ENV_ENABLE, "1")
+    planned = m.run(conf, mesh=mesh8)
+    assert planned["test_error"] == naive["test_error"]
+    assert planned["train_error"] == naive["train_error"]
+
+
+# ---------------------------------------------------------------------------
+# comms-aware staging/sharding pass
+
+
+def test_choose_staging_depth_from_cost_model(monkeypatch):
+    monkeypatch.delenv("KEYSTONE_STAGE_DEPTH", raising=False)
+
+    def plan_with(input_bytes, wall_s):
+        node = PlanNode(
+            label="n",
+            op=transformer(lambda b: b),
+            cost=NodeCost(
+                input_bytes=input_bytes, wall_s=wall_s, source="sampled"
+            ),
+        )
+        return Plan(prefix=[node], budget_bytes=1 << 20, chunk_size=100)
+
+    # transfer-bound (1000 B/row over ~2e10 B/s vs 0.1 ns/row compute):
+    # staging goes deeper than double-buffering, capped at 4
+    p = plan_passes.choose_staging(plan_with(1000.0, 1e-10), n_rows=1000)
+    assert p.stage_depth == 4
+    stage = next(d for d in p.decisions if d["action"] == "stage")
+    assert stage["source"] == "cost_model" and not stage["hidden"]
+
+    # compute-bound: double buffering hides the transfer entirely
+    p = plan_passes.choose_staging(plan_with(1.0, 1e-3), n_rows=1000)
+    assert p.stage_depth == 2
+    stage = next(d for d in p.decisions if d["action"] == "stage")
+    assert stage["hidden"]
+
+    # env override wins over the cost model
+    monkeypatch.setenv("KEYSTONE_STAGE_DEPTH", "3")
+    p = plan_passes.choose_staging(plan_with(1000.0, 1e-10), n_rows=1000)
+    assert p.stage_depth == 3
+    assert any(
+        d["action"] == "stage" and d["source"] == "env" for d in p.decisions
+    )
+
+
+def test_choose_staging_shard_decision_rounds_chunk(mesh8):
+    node = PlanNode(
+        label="n",
+        op=transformer(lambda b: b),
+        cost=NodeCost(wall_s=1e-6, source="sampled"),
+    )
+    p = Plan(prefix=[node], budget_bytes=1 << 20, chunk_size=100, mesh=mesh8)
+    plan_passes.choose_staging(p, n_rows=1000)
+    assert p.shard and p.chunk_size == 104  # rounded UP to a multiple of 8
+    shard = next(d for d in p.decisions if d["action"] == "shard")
+    assert shard["shards"] == 8 and shard["axis"] == "data"
+    # no mesh → no shard decision
+    p2 = Plan(prefix=[node], budget_bytes=1 << 20, chunk_size=100)
+    plan_passes.choose_staging(p2, n_rows=1000)
+    assert not p2.shard
+
+
+def test_chunk_size_choice_scales_with_shards():
+    """A sharded chunk splits its working set over the mesh: the same
+    budget admits shards x more rows per dispatch, kept divisible."""
+    node = PlanNode(
+        label="n",
+        op=transformer(lambda b: b),
+        cost=NodeCost(peak_bytes=1024.0, source="sampled"),
+    )
+    p = Plan(prefix=[node], budget_bytes=1 << 20, rows=64)
+    plan_passes.choose_chunk_size(p, n_rows=1 << 20, shards=8)
+    assert p.chunk_size == 2048  # 8 x the single-device 256
+    assert p.chunk_size % 8 == 0
+
+
+def test_node_cost_comms_terms():
+    cost = NodeCost(input_bytes=100.0, collective_bytes=10.0)
+    # cpu peaks: h2d 2e10 B/s, ici 2e10 B/s
+    assert cost.h2d_s(1000) == pytest.approx(100.0 * 1000 / 2e10)
+    assert cost.collective_s(1000) == pytest.approx(10.0 * 1000 / 2e10)
+    from keystone_tpu.plan.ir import device_peaks
+
+    assert len(device_peaks("TPU v4")) == 4
+    assert len(device_peaks(None)) == 4
+
+
+# ---------------------------------------------------------------------------
 # env gate + CLI
 
 

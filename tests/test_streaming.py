@@ -184,6 +184,67 @@ def test_prefetch_batches_releases_producer_on_abandon():
     assert len(produced) < 100, "producer should stop early, not drain"
 
 
+def test_featurize_stream_sharded_matches_single_device(rng, mesh8):
+    """Mesh-sharded featurize_stream (each staged chunk placed across
+    the 8-way data axis, chunk rounded up to a mesh-divisible static
+    shape) is bit-exact vs the synchronous single-device drain."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.observe import metrics as observe_metrics
+
+    batches = [
+        rng.normal(size=(b, 8, 8, 3)).astype(np.float32)
+        for b in (40, 24, 9)
+    ]
+    fn = jax.jit(lambda b: jnp.sum(b, axis=(1, 2)))
+    ref = featurize_stream(
+        iter(batches), fn, chunk_size=30, prefetch=0, stage_depth=0
+    )
+    before = observe_metrics.get_registry().snapshot()
+    sharded = featurize_stream(iter(batches), fn, chunk_size=30, mesh=mesh8)
+    after = observe_metrics.get_registry().snapshot()
+    assert ref.shape == (73, 3)
+    np.testing.assert_array_equal(ref, sharded)
+    # 30 rounds up to 32 for even shards; every chunk staged + sharded,
+    # ragged tails zero-padded (the engine's total-pad counter)
+    assert after.get("plan_shard_chunks", 0) > before.get(
+        "plan_shard_chunks", 0
+    )
+    assert after.get("plan_transfer_pad_rows", 0) > before.get(
+        "plan_transfer_pad_rows", 0
+    )
+
+
+def test_featurize_stream_stage_depth_env(monkeypatch, rng):
+    """KEYSTONE_STAGE_DEPTH=0 disables the staging thread (inline
+    synchronous placement) — outputs identical either way."""
+    import jax.numpy as jnp
+
+    batches = [
+        rng.normal(size=(b, 8, 8, 3)).astype(np.float32) for b in (64, 17)
+    ]
+    fn = jax.jit(lambda b: jnp.mean(b, axis=(1, 2)))
+    staged = featurize_stream(iter(batches), fn, chunk_size=32)
+    monkeypatch.setenv("KEYSTONE_STAGE_DEPTH", "0")
+    sync = featurize_stream(iter(batches), fn, chunk_size=32)
+    np.testing.assert_array_equal(staged, sync)
+
+
+def test_featurize_stream_source_error_propagates_through_engine(rng):
+    """A batch source that dies mid-stream re-raises at the
+    featurize_stream caller even though the staging engine pulls it from
+    a background thread."""
+    import jax.numpy as jnp
+
+    def bad_batches():
+        yield rng.normal(size=(16, 4, 4, 3)).astype(np.float32)
+        raise RuntimeError("tar decode exploded")
+
+    fn = jax.jit(lambda b: jnp.mean(b, axis=(1, 2)))
+    with pytest.raises(RuntimeError, match="tar decode exploded"):
+        featurize_stream(bad_batches(), fn, chunk_size=8)
+
+
 def test_prefetch_batches_propagates_producer_error():
     from keystone_tpu.loaders.streaming import prefetch_batches
 

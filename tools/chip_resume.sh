@@ -12,6 +12,7 @@ cd "$DIR"
 log() { echo "=== $(date -u +%FT%TZ) $*"; }
 
 IMG_PID=""
+IMG_PGID=""
 if [ -f .imagenet_pid ]; then
   IMG_PID="$(awk '{print $2}' .imagenet_pid)"
   # identity check, not just liveness: a recycled PID must not get
@@ -19,17 +20,40 @@ if [ -f .imagenet_pid ]; then
   if [ -n "$IMG_PID" ] \
      && grep -q "imagenet_scale_run" "/proc/$IMG_PID/cmdline" 2>/dev/null; then
     log "pausing CPU imagenet run (pid $IMG_PID) for the chip window"
-    pkill -STOP -P "$IMG_PID" 2>/dev/null
-    kill -STOP "$IMG_PID" 2>/dev/null
+    # STOP the whole process GROUP when possible: stopping children
+    # before the parent raced (the parent could spawn a replacement
+    # between the pkill and its own STOP) and direct-child matching
+    # never reached grandchildren. The group signal is atomic over all
+    # members, present and nested.
+    IMG_PGID="$(ps -o pgid= -p "$IMG_PID" 2>/dev/null | tr -d ' ')"
+    MY_PGID="$(ps -o pgid= -p $$ 2>/dev/null | tr -d ' ')"
+    # compare OUR pgid (not pid): a wrapper without job control puts us
+    # in the same group as the imagenet run — group-STOP would freeze
+    # this script too, so fall back to per-pid signaling there
+    if [ -n "$IMG_PGID" ] && [ "$IMG_PGID" != "$MY_PGID" ] \
+       && kill -STOP -- "-$IMG_PGID" 2>/dev/null; then
+      :
+    else
+      # fallback (shared/unreadable pgroup): parent FIRST so it cannot
+      # spawn new children after we sweep, then the direct children
+      IMG_PGID=""
+      kill -STOP "$IMG_PID" 2>/dev/null
+      pkill -STOP -P "$IMG_PID" 2>/dev/null
+    fi
   else
     IMG_PID=""
   fi
 fi
 resume_img() {
-  if [ -n "$IMG_PID" ]; then
+  if [ -n "$IMG_PGID" ]; then
+    log "resuming CPU imagenet run (pgid $IMG_PGID)"
+    kill -CONT -- "-$IMG_PGID" 2>/dev/null
+  elif [ -n "$IMG_PID" ]; then
     log "resuming CPU imagenet run (pid $IMG_PID)"
-    kill -CONT "$IMG_PID" 2>/dev/null
+    # children first on CONT: the parent must not observe stopped
+    # children after it resumes (mirror of the STOP ordering)
     pkill -CONT -P "$IMG_PID" 2>/dev/null
+    kill -CONT "$IMG_PID" 2>/dev/null
   fi
 }
 trap resume_img EXIT

@@ -642,8 +642,11 @@ def main() -> int:
     # honor a JAX_PLATFORMS pin via jax.config too (same treatment as
     # tools/imagenet_scale_run.py): the sandbox's TPU plugin hooks
     # get_backend, so on a wedged tunnel even the backend QUERY below
-    # hangs forever without this — the refusal path must be reachable
-    plat = os.environ.get("JAX_PLATFORMS", "").split(",")[0]
+    # hangs forever without this — the refusal path must be reachable.
+    # Pass the FULL comma-separated priority list: "tpu,cpu" means "tpu
+    # with cpu fallback", and keeping only the first entry silently
+    # dropped that fallback (ADVICE.md round 5)
+    plat = os.environ.get("JAX_PLATFORMS", "").strip()
     if plat:
         jax.config.update("jax_platforms", plat)
     backend = jax.default_backend()
@@ -659,6 +662,8 @@ def main() -> int:
     }
     out = REPO / "TPU_VALIDATION.json"
 
+    succeeded: set[str] = set()
+
     def _flush() -> dict:
         # merge-update: opt-in sections (e.g. the 32k long-context
         # record) must survive runs that don't re-validate them. Written
@@ -670,6 +675,11 @@ def main() -> int:
         except Exception:  # noqa: BLE001 — first run / corrupt file
             prior = {}
         merged = {**prior, **results}
+        # a probe that succeeded THIS run retires its stale _error key
+        # from earlier runs — the merge would otherwise keep a failure
+        # marker forever next to fresh passing numbers (ADVICE.md r5)
+        for name in succeeded:
+            merged.pop(f"{name}_error", None)
         out.write_text(json.dumps(merged, indent=2) + "\n")
         return merged
 
@@ -686,6 +696,8 @@ def main() -> int:
     for probe in probes:
         try:
             probe(results)
+            succeeded.add(probe.__name__)
+            results.pop(f"{probe.__name__}_error", None)
         except Exception as e:  # noqa: BLE001 — record, keep validating
             failed.append(probe.__name__)
             results[f"{probe.__name__}_error"] = f"{type(e).__name__}: {e}"
