@@ -809,6 +809,70 @@ def bench_lm_decode() -> dict:
     }
 
 
+def bench_lm_step_telemetry() -> dict:
+    """Tiny LM train loop driven through the live telemetry stream
+    (observe/telemetry.py): steps/s p50/p95 from the per-step records
+    plus the HBM peak watermark, so BENCH_*.json carries a perf
+    trajectory for the TRAIN LOOP itself (per-step host overhead, step
+    cadence), not just the single-step rates above. Deliberately small —
+    it runs on the CPU fallback too."""
+    import jax
+
+    from keystone_tpu.models import lm_transformer as lm
+    from keystone_tpu.observe import events as observe_events
+    from keystone_tpu.observe import telemetry
+
+    steps = 24
+
+    def run_loop() -> list[dict]:
+        corpus = lm.synthetic_corpus(4096, 256, seed=0)
+        model = lm.TransformerLM.create(
+            jax.random.key(0), vocab=256, max_seq=64, dim=64, depth=2,
+            num_heads=4,
+        )
+        lm.train(model, corpus, steps=steps, batch=8, seq=64, lr=1e-3)
+        sl = telemetry.active_step_log()
+        recs = list(sl.records) if sl is not None else []
+        return [r for r in recs if r.get("source") == "train"][-steps:]
+
+    if observe_events.active() is not None:
+        recs = run_loop()  # ambient run dir: records land there too
+    else:
+        with observe_events.run(workload="lm_step_telemetry"):
+            recs = run_loop()
+    # drop the first record (jit compile dominates it) from the cadence
+    walls = [
+        r["wall_s"] for r in recs if isinstance(r.get("wall_s"), (int, float))
+    ]
+    walls = walls[1:] or walls
+    rates = [1.0 / w for w in walls if w > 0]
+    p_rate = telemetry.percentiles(rates, (5, 50, 95))
+    p_wall = telemetry.percentiles(walls, (50, 95))
+    out: dict = {"steps": len(recs)}
+    if p_rate:
+        # p95 steps/s is the FAST tail; p5 is the stall tail
+        out.update(
+            steps_per_s_p50=round(p_rate[50], 3),
+            steps_per_s_p95=round(p_rate[95], 3),
+            steps_per_s_p5=round(p_rate[5], 3),
+            step_ms_p50=round(p_wall[50] * 1e3, 2),
+            step_ms_p95=round(p_wall[95] * 1e3, 2),
+        )
+    mfus = [r["mfu"] for r in recs if isinstance(r.get("mfu"), (int, float))]
+    if mfus:
+        out["mfu_p50"] = round(
+            telemetry.percentiles(mfus, (50,))[50], 6
+        )
+    hbm = [
+        r["hbm_peak_bytes"]
+        for r in recs
+        if isinstance(r.get("hbm_peak_bytes"), (int, float))
+    ]
+    if hbm:
+        out["peak_hbm_bytes"] = int(max(hbm))
+    return out
+
+
 def bench_sift() -> dict:
     """Dense-SIFT featurize, device (XLA) path, with the C++ host kernel
     (native/dsift.cpp, the VLFeat-shim parity fallback) as baseline."""
@@ -1117,6 +1181,16 @@ def main() -> None:
         "baseline": "numpy/BLAS single-host CPU, same workloads "
         "(reference publishes no numbers; see BASELINE.md)",
     }
+    # train-loop telemetry trajectory (observe/telemetry.py): per-step
+    # cadence percentiles + HBM watermark from the live stream — runs on
+    # the CPU fallback too, so the record is never absent
+    try:
+        result["lm_step_telemetry"] = bench_lm_step_telemetry()
+    except Exception as e:  # noqa: BLE001 — telemetry must not cost the
+        # bench its headline number
+        result["lm_step_telemetry"] = {
+            "error": f"{type(e).__name__}: {str(e)[:200]}"
+        }
     # per-node operator breakdown (observe subsystem): wall time per
     # pipeline node plus compiler-modeled FLOPs/bytes when available
     result["mnist_per_node"] = mnist.get("per_node", {})

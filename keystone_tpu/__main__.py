@@ -96,7 +96,8 @@ def main(argv: list[str] | None = None) -> None:
             f" are also accepted; --multihost joins this process into the\n"
             f" jax.distributed runtime before dispatch — run the same command"
             f" on every host; --observe DIR writes a structured per-node\n"
-            f" event log there, rendered by `observe <dir>`; `faults --list`\n"
+            f" event log there, rendered by `observe <dir>` and tailed live"
+            f" by\n `observe top <dir>`; `faults --list`\n"
             f" prints the KEYSTONE_FAULTS injection sites; `plan <model>`\n"
             f" prints the cost-based planner's chosen plan without executing)"
         )
@@ -138,6 +139,26 @@ def main(argv: list[str] | None = None) -> None:
         import os
 
         observe_dir = os.environ.get("KEYSTONE_OBSERVE_DIR") or None
+    def rollup():
+        # multihost metrics roll-up: every host calls it (collective
+        # barrier); host 0 merges cluster totals into the run dir so the
+        # report isn't host-0-only. Never fatal.
+        if not multihost:
+            return
+        try:
+            from keystone_tpu.observe import events as _events
+            from keystone_tpu.parallel import multihost as mh_roll
+
+            log = _events.active()
+            mh_roll.rollup_metrics(log.run_dir if log else None)
+        except Exception as e:  # noqa: BLE001
+            import sys as _sys
+
+            print(
+                f"# multihost metrics roll-up failed: {e!r}",
+                file=_sys.stderr,
+            )
+
     if observe_dir is not None:
         # scoped run: the launcher brackets the whole pipeline with
         # run_start/run_end so the report knows total wall and status
@@ -145,8 +166,10 @@ def main(argv: list[str] | None = None) -> None:
 
         with events.run(observe_dir, pipeline=name, argv=rest):
             dispatch()
+            rollup()
     else:
         dispatch()
+        rollup()
 
 
 if __name__ == "__main__":

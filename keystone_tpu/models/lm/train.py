@@ -27,6 +27,7 @@ from keystone_tpu.models.lm.model import (
     _embed,
     _tied_logits,
     has_quantized_leaves,
+    train_step_flops,
 )
 
 logger = get_logger("keystone_tpu.models.lm_transformer")
@@ -313,9 +314,13 @@ def train(
     import os as _os
     import signal as _signal
     import threading as _threading
+    import time as _time
 
     import jax.numpy as jnp
 
+    from keystone_tpu.observe import devices as _observe_devices
+    from keystone_tpu.observe import telemetry as _telemetry
+    from keystone_tpu.observe import tracing as _tracing
     from keystone_tpu.parallel.mesh import data_sharding
     from keystone_tpu.resilience import faults as _faults
     from keystone_tpu.resilience.guards import (
@@ -480,6 +485,21 @@ def train(
         # otherwise guarantee a spurious stall report on every run
         dog = Watchdog(step_timeout_s, label="lm_train")
 
+    # live telemetry (observe/telemetry.py): per-step loss / tokens-per-s
+    # / MFU into steps.jsonl whenever an observe sink is active, HBM
+    # watermark sampling, and programmatic profiler windows
+    # (KEYSTONE_PROFILE_STEPS / SIGUSR2). With no sink and no windows the
+    # per-step cost is one global read (active_step_log) plus one no-op
+    # tracer check.
+    step_flops = train_step_flops(model, batch, seq)
+    devmon = _observe_devices.DeviceMemoryMonitor()
+    tracer = _tracing.StepTracer.from_env(
+        install_signal=(
+            _threading.current_thread() is _threading.main_thread()
+        ),
+        label="lm_train",
+    )
+
     completed = last_saved = 0
     halted = False
     try:
@@ -493,6 +513,9 @@ def train(
                 )
         completed = last_saved = start
         for i in range(start, steps):
+            if tracer is not None:
+                tracer.step(i)
+            t_step0 = _time.perf_counter()
             toks = jnp.asarray(_step_batch(corpus, seed, i, batch, seq))
             if sharding is not None:
                 toks = jax.device_put(toks, sharding)
@@ -505,8 +528,21 @@ def train(
                 model, opt_state, loss = step(model, opt_state, toks)
             # keep the loss on device: a float() here would block a host
             # round-trip into every step and serialize the dispatch queue
+            # (exception: an active telemetry sink reads the scalar below
+            # — that host read IS the live stream's cost, and it makes
+            # the recorded per-step wall honest under async dispatch)
             losses.append(loss)
             completed = i + 1
+            steplog = _telemetry.active_step_log()
+            if steplog is not None:
+                steplog.step(
+                    step=i + 1,
+                    loss=float(loss),
+                    tokens=batch * seq,
+                    wall_s=_time.perf_counter() - t_step0,
+                    flops=step_flops,
+                    hbm_peak_bytes=devmon.maybe_sample(),
+                )
             # one host sync per check interval, not per step
             loss_guard.note(i, loss)
             if dog is not None:
@@ -577,6 +613,8 @@ def train(
                 ckpt.close()
             if dog is not None:
                 dog.stop()
+            if tracer is not None:
+                tracer.close()
             for s, h in prev_handlers.items():
                 _signal.signal(s, h)
     if loss_guard.skipped:

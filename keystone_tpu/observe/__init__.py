@@ -12,6 +12,10 @@ docstring):
 - :mod:`.cost` — per-node FLOPs/bytes/memory profiles from
   ``jax.jit(...).lower().compile().cost_analysis()``
 - :mod:`.report` — per-node run summary + the ``observe`` CLI
+- :mod:`.telemetry` — live per-step stream (``steps.jsonl``)
+- :mod:`.devices` — per-device HBM watermark sampling
+- :mod:`.tracing` — programmatic profiler trace windows
+- :mod:`.top` — the ``observe top`` terminal dashboard
 
 ``events`` and ``metrics`` are stdlib-light and imported eagerly (the
 core pipeline hooks depend on them); ``instrument``/``cost``/``report``
@@ -29,6 +33,10 @@ _LAZY = {
     "instrument": "keystone_tpu.observe.instrument",
     "cost": "keystone_tpu.observe.cost",
     "report": "keystone_tpu.observe.report",
+    "telemetry": "keystone_tpu.observe.telemetry",
+    "devices": "keystone_tpu.observe.devices",
+    "tracing": "keystone_tpu.observe.tracing",
+    "top": "keystone_tpu.observe.top",
 }
 
 

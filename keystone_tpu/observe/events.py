@@ -61,6 +61,30 @@ def node_label(node: Any, index: int | None = None) -> str:
     return f"{index:02d}:{name}" if index is not None else name
 
 
+def write_record(fh, rec: dict, sink_name: str):
+    """Serialize ``rec`` and append it to JSONL sink ``fh`` — the ONE
+    home of the write-or-degrade contract shared by the event log and
+    the step-telemetry stream (``default=repr``: a non-JSON field is a
+    per-record problem, stringify it rather than losing the record; a
+    circular reference skips the record; an OSError disables the sink
+    with one warning). Returns ``fh``, or None when the sink must be
+    disabled. The caller holds its own lock."""
+    try:
+        line = json.dumps(rec, default=repr)
+    except ValueError:  # circular reference: skip this record
+        return fh
+    try:
+        fh.write(line + "\n")
+    except OSError as e:
+        from keystone_tpu.core.logging import get_logger
+
+        get_logger("keystone_tpu.observe").warning(
+            "%s write failed (%r); file sink disabled", sink_name, e
+        )
+        return None
+    return fh
+
+
 class EventLog:
     """A single run's event sink: JSONL file plus an in-memory mirror.
 
@@ -95,24 +119,7 @@ class EventLog:
             else:
                 self.dropped += 1
             if self._fh is not None:
-                # default=repr: a non-JSON field (numpy scalar, array) is
-                # a per-record problem — stringify it rather than losing
-                # the record, let alone the sink
-                try:
-                    line = json.dumps(rec, default=repr)
-                except ValueError:  # circular reference: skip this record
-                    line = None
-                if line is not None:
-                    try:
-                        self._fh.write(line + "\n")
-                    except OSError as e:
-                        self._fh = None
-                        from keystone_tpu.core.logging import get_logger
-
-                        get_logger("keystone_tpu.observe").warning(
-                            "event log write failed (%r); file sink disabled",
-                            e,
-                        )
+                self._fh = write_record(self._fh, rec, "event log")
         return rec
 
     @contextlib.contextmanager
@@ -143,6 +150,11 @@ class EventLog:
         )
 
     def close(self) -> None:
+        # the per-step telemetry stream (observe/telemetry.py) binds its
+        # StepLog to this log's lifetime — close it with the run
+        steplog = self.__dict__.pop("_steplog", None)
+        if steplog is not None:
+            steplog.close()
         with self._lock:
             if self._fh is not None:
                 try:
@@ -302,17 +314,36 @@ def resolve_run_dir(path: str) -> str:
 
 
 def read_events(path: str) -> list[dict]:
-    """Parse a run's ``events.jsonl`` (corrupt lines are skipped — a
-    crashed writer must not make the whole run unreadable)."""
+    """Parse a run's ``events.jsonl``. Unparseable records — above all
+    the torn FINAL line a crashed or SIGKILLed writer leaves mid-record
+    — are skipped with one warning naming the line(s), so the run stays
+    readable and the loss stays visible."""
     run_dir = resolve_run_dir(path)
+    return read_jsonl(os.path.join(run_dir, EVENTS_FILE))
+
+
+def read_jsonl(file_path: str) -> list[dict]:
+    """Tolerant JSONL reader shared by the event log and the step
+    telemetry stream (same crash-torn-tail failure mode)."""
     out: list[dict] = []
-    with open(os.path.join(run_dir, EVENTS_FILE)) as f:
-        for line in f:
+    bad: list[int] = []
+    with open(file_path) as f:
+        for lineno, line in enumerate(f, 1):
             line = line.strip()
             if not line:
                 continue
             try:
                 out.append(json.loads(line))
             except ValueError:
-                continue
+                bad.append(lineno)
+    if bad:
+        from keystone_tpu.core.logging import get_logger
+
+        get_logger("keystone_tpu.observe").warning(
+            "%s: skipped %d unparseable record(s) at line(s) %s — torn "
+            "final line from a killed writer, or corruption",
+            file_path,
+            len(bad),
+            bad[:5],
+        )
     return out

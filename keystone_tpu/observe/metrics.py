@@ -14,16 +14,98 @@ streaming loaders' decode-ahead thread) can record freely.
 from __future__ import annotations
 
 import contextlib
+import random
 import threading
 import time
-from typing import Any, Iterator
+from typing import Any, Iterable, Iterator, Sequence
+
+# label-value characters that collide with the key syntax itself — a
+# value like a node repr ("f{x}, y=2") must not alias another series
+_ESCAPES = ("\\", ",", "=", "{", "}")
+
+
+def _escape(value: str) -> str:
+    for ch in _ESCAPES:
+        value = value.replace(ch, "\\" + ch)
+    return value
+
+
+def _unescape(value: str) -> str:
+    out: list[str] = []
+    i = 0
+    while i < len(value):
+        if value[i] == "\\" and i + 1 < len(value):
+            out.append(value[i + 1])
+            i += 2
+        else:
+            out.append(value[i])
+            i += 1
+    return "".join(out)
+
+
+def _split_unescaped(s: str, sep: str, maxsplit: int = -1) -> list[str]:
+    """Split on ``sep`` outside backslash escapes (escapes preserved)."""
+    parts: list[str] = []
+    cur: list[str] = []
+    i = 0
+    while i < len(s):
+        c = s[i]
+        if c == "\\" and i + 1 < len(s):
+            cur.append(c)
+            cur.append(s[i + 1])
+            i += 2
+            continue
+        if c == sep and maxsplit != 0:
+            parts.append("".join(cur))
+            cur = []
+            if maxsplit > 0:
+                maxsplit -= 1
+            i += 1
+            continue
+        cur.append(c)
+        i += 1
+    parts.append("".join(cur))
+    return parts
 
 
 def _series_key(name: str, labels: dict[str, Any]) -> str:
     if not labels:
         return name
-    inner = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+    inner = ",".join(f"{k}={_escape(str(labels[k]))}" for k in sorted(labels))
     return f"{name}{{{inner}}}"
+
+
+def parse_series_key(key: str) -> tuple[str, dict[str, str]]:
+    """Invert :func:`_series_key`: ``'calls{node=a}'`` →
+    ``('calls', {'node': 'a'})``. Label values round-trip even when they
+    contain ``,``/``=``/``{``/``}`` (escaped on the way in)."""
+    if not key.endswith("}"):
+        return key, {}
+    brace = key.find("{")
+    if brace < 0:
+        return key, {}
+    name, inner = key[:brace], key[brace + 1 : -1]
+    labels: dict[str, str] = {}
+    for part in _split_unescaped(inner, ","):
+        kv = _split_unescaped(part, "=", maxsplit=1)
+        if len(kv) != 2:
+            continue
+        labels[_unescape(kv[0])] = _unescape(kv[1])
+    return name, labels
+
+
+def percentiles(
+    values: Sequence[float], qs: Iterable[float] = (50, 95, 99)
+) -> dict[float, float]:
+    """Nearest-rank percentiles of ``values`` (empty input → {})."""
+    vals = sorted(values)
+    if not vals:
+        return {}
+    out: dict[float, float] = {}
+    for q in qs:
+        idx = min(int(round(q / 100.0 * (len(vals) - 1))), len(vals) - 1)
+        out[q] = vals[idx]
+    return out
 
 
 class Counter:
@@ -54,10 +136,18 @@ class Gauge:
             self.value = v
 
 
-class Timer:
-    """Duration summary: count / total / min / max seconds."""
+# per-timer reservoir cap: enough resolution for p99 on long runs,
+# bounded so a million-step loop can't grow the host heap
+_RESERVOIR_CAP = 512
 
-    __slots__ = ("_lock", "count", "total", "min", "max")
+
+class Timer:
+    """Duration summary: count / total / min / max seconds, plus a
+    bounded reservoir (Vitter's algorithm R, deterministic seed) so
+    :meth:`summary` can report p50/p95/p99 — the tail a min/max pair
+    hides — without unbounded memory."""
+
+    __slots__ = ("_lock", "count", "total", "min", "max", "samples", "_rng")
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
@@ -65,6 +155,8 @@ class Timer:
         self.total = 0.0
         self.min = float("inf")
         self.max = 0.0
+        self.samples: list[float] = []
+        self._rng = random.Random(0x5EED)
 
     def observe(self, seconds: float) -> None:
         with self._lock:
@@ -72,6 +164,18 @@ class Timer:
             self.total += seconds
             self.min = min(self.min, seconds)
             self.max = max(self.max, seconds)
+            if len(self.samples) < _RESERVOIR_CAP:
+                self.samples.append(seconds)
+            else:
+                j = self._rng.randrange(self.count)
+                if j < _RESERVOIR_CAP:
+                    self.samples[j] = seconds
+
+    def percentile(self, q: float) -> float:
+        """Estimated q-th percentile from the reservoir (0.0 when empty)."""
+        with self._lock:
+            samples = list(self.samples)
+        return percentiles(samples, (q,)).get(q, 0.0)
 
     @contextlib.contextmanager
     def time(self) -> Iterator[None]:
@@ -84,13 +188,17 @@ class Timer:
     def summary(self) -> dict:
         with self._lock:
             mean = self.total / self.count if self.count else 0.0
-            return {
+            out = {
                 "count": self.count,
                 "total_s": self.total,
                 "mean_s": mean,
                 "min_s": self.min if self.count else 0.0,
                 "max_s": self.max,
             }
+            if self.samples:
+                p = percentiles(self.samples, (50, 95, 99))
+                out.update(p50_s=p[50], p95_s=p[95], p99_s=p[99])
+            return out
 
 
 class MetricsRegistry:
@@ -132,6 +240,25 @@ class MetricsRegistry:
         out: dict[str, Any] = {}
         for key, (kind, series) in items:
             out[key] = series.summary() if kind == "timer" else series.value
+        return out
+
+    def dump(self) -> dict[str, dict]:
+        """Kind-tagged snapshot for cross-process merging (the multihost
+        roll-up): series key → ``{"kind": ..., "value": ...}`` for
+        counters/gauges, ``{"kind": "timer", **summary, "samples":
+        [...]}`` for timers — the reservoir rides along so merged
+        percentiles come from pooled samples, not averaged quantiles."""
+        with self._lock:
+            items = list(self._series.items())
+        out: dict[str, dict] = {}
+        for key, (kind, series) in items:
+            if kind == "timer":
+                entry: dict[str, Any] = {"kind": "timer", **series.summary()}
+                with series._lock:
+                    entry["samples"] = list(series.samples)
+                out[key] = entry
+            else:
+                out[key] = {"kind": kind, "value": series.value}
         return out
 
     def reset(self) -> None:

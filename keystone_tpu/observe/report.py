@@ -10,30 +10,31 @@ bf16, HBM ≈ 819 GB/s; CPU runs have no peak entry and show ``-``).
 
 from __future__ import annotations
 
+import json
+import os
 import sys
 from typing import Any
 
 from keystone_tpu.observe import cost as _cost
 from keystone_tpu.observe import events as _events
 
-# bf16 MXU peak per chip, keyed by device_kind substring — the ONE home
-# of the roofline basis (ROOFLINE.md; the f32 MXU rate is lower, so f32
-# workloads report conservative MFU on this basis). bench.py and
-# tools/mfu_sweep.py import these rather than carrying copies.
-PEAK_FLOPS = {"v5 lite": 197e12, "v5p": 459e12, "v4": 275e12}
-HBM_BYTES_PER_S = 819e9
+# The roofline basis lives in ONE place now:
+# :data:`keystone_tpu.plan.costs.DEVICE_PEAKS` (bf16 MXU peak, HBM B/s,
+# PCIe B/s, ICI B/s per device kind — ROOFLINE.md). Re-exported here so
+# bench.py / tools/mfu_sweep.py keep their historical import site and
+# the report's vs_peak column can never drift from the planner's
+# transfer/recompute estimates.
+from keystone_tpu.plan.costs import (  # noqa: F401 — re-exports
+    DEVICE_PEAKS,
+    peak_flops_for,
+)
 
-
-def peak_flops_for(device_kind: str | None) -> float | None:
-    """bf16 peak for a jax ``device_kind`` string, or None when unknown
-    (CPU, new chip generations)."""
-    if not device_kind:
-        return None
-    kind = device_kind.lower()
-    for key, peak in PEAK_FLOPS.items():
-        if key in kind:
-            return peak
-    return None
+#: legacy aliases (pre-single-sourcing callers): bf16 peaks per chip and
+#: the v5e HBM stream rate, both views of DEVICE_PEAKS
+PEAK_FLOPS = {
+    kind: peaks[0] for kind, peaks in DEVICE_PEAKS.items() if kind != "cpu"
+}
+HBM_BYTES_PER_S = DEVICE_PEAKS["v5 lite"][1]
 
 
 def summarize(events: list[dict]) -> dict[str, Any]:
@@ -44,6 +45,8 @@ def summarize(events: list[dict]) -> dict[str, Any]:
     phases: list[dict] = []
     spans: list[dict] = []
     optimizes: list[dict] = []
+    device_memory: dict | None = None
+    trace_windows: list[dict] = []
     meta: dict[str, Any] = {"run": None, "wall_s": None, "status": None}
     for ev in events:
         kind = ev.get("event")
@@ -70,6 +73,10 @@ def summarize(events: list[dict]) -> dict[str, Any]:
             spans.append(ev)
         elif kind == "optimize":
             optimizes.append(ev)
+        elif kind == "device_memory":
+            device_memory = ev  # latest sample carries current watermarks
+        elif kind == "trace_window":
+            trace_windows.append(ev)
         elif kind == "run_end":
             meta["wall_s"] = ev.get("wall_s")
             meta["status"] = ev.get("status")
@@ -80,6 +87,8 @@ def summarize(events: list[dict]) -> dict[str, Any]:
         "phases": phases,
         "spans": spans,
         "optimizes": optimizes,
+        "device_memory": device_memory,
+        "trace_windows": trace_windows,
     }
 
 
@@ -201,12 +210,146 @@ def render(run_dir: str) -> str:
                 )
                 lines.append(f"  [{src}] {fields}")
         lines.append("")
+    lines.extend(_telemetry_sections(run_dir, summary))
     if peak is None and profiles:
         lines.append(
             "(no bf16 peak known for this device kind — vs_peak omitted; "
             "roofline basis: ROOFLINE.md)"
         )
     return "\n".join(lines)
+
+
+def _telemetry_sections(run_dir: str, summary: dict) -> list[str]:
+    """Live-telemetry report sections: the per-step stream summary
+    (``steps.jsonl``), device-memory watermarks, profiler trace windows,
+    and the multihost cluster roll-up (``metrics_cluster.json``)."""
+    from keystone_tpu.observe import telemetry as _telemetry
+    from keystone_tpu.observe.metrics import percentiles
+
+    lines: list[str] = []
+    steps_path = os.path.join(run_dir, _telemetry.STEPS_FILE)
+    if os.path.isfile(steps_path):
+        recs = _events.read_jsonl(steps_path)
+        # plan chunk-stream rows (source="plan") carry whole-stream
+        # walls on a process-lifetime sequence — summarized separately
+        # so they can't inflate the per-step percentiles
+        steps = [
+            r
+            for r in recs
+            if "step" in r and r.get("source", "train") == "train"
+        ]
+        plan_rows = [r for r in recs if r.get("source") == "plan"]
+        if steps:
+            last = steps[-1]
+            walls = [
+                r["wall_s"]
+                for r in steps
+                if isinstance(r.get("wall_s"), (int, float))
+            ]
+            p = percentiles(walls, (50, 95, 99)) if walls else {}
+            line = f"live telemetry: {len(steps)} step record(s)"
+            if "step" in last:
+                line += f", last step {last['step']}"
+            if isinstance(last.get("loss"), (int, float)):
+                line += f", loss {last['loss']:.4f}"
+            lines.append(line)
+            if p:
+                lines.append(
+                    f"  step wall p50 {p[50] * 1e3:.1f} ms  "
+                    f"p95 {p[95] * 1e3:.1f} ms  p99 {p[99] * 1e3:.1f} ms"
+                )
+            rates = [
+                r["tokens_per_s"]
+                for r in steps
+                if isinstance(r.get("tokens_per_s"), (int, float))
+            ]
+            mfus = [
+                r["mfu"]
+                for r in steps
+                if isinstance(r.get("mfu"), (int, float))
+            ]
+            if rates:
+                lines.append(
+                    f"  tokens/s last {rates[-1]:,.0f}  "
+                    f"best {max(rates):,.0f}"
+                    + (f"  mfu last {mfus[-1]:.4f}" if mfus else "")
+                )
+            lines.append("")
+        if plan_rows:
+            rows = sum(
+                r["rows"]
+                for r in plan_rows
+                if isinstance(r.get("rows"), (int, float))
+            )
+            rps = [
+                r["rows_per_s"]
+                for r in plan_rows
+                if isinstance(r.get("rows_per_s"), (int, float))
+            ]
+            lines.append(
+                f"plan chunk streams: {len(plan_rows)} record(s), "
+                f"{int(rows)} row(s)"
+                + (f", last {rps[-1]:,.0f} rows/s" if rps else "")
+            )
+            lines.append("")
+    devmem = summary.get("device_memory")
+    if devmem:
+        lines.append("device memory (HBM watermarks, latest sample):")
+        for d in devmem.get("devices") or []:
+            limit = d.get("bytes_limit") or 0
+            pct = (
+                f"  ({100.0 * d['peak_bytes_in_use'] / limit:.0f}% of limit)"
+                if limit
+                else ""
+            )
+            lines.append(
+                f"  {d.get('device', '?'):12} "
+                f"in-use {d.get('bytes_in_use', 0) / 2**30:7.2f} GiB  "
+                f"peak {d.get('peak_bytes_in_use', 0) / 2**30:7.2f} GiB{pct}"
+            )
+        lines.append("")
+    if summary.get("trace_windows"):
+        started = [
+            ev
+            for ev in summary["trace_windows"]
+            if ev.get("status") == "started"
+        ]
+        if started:
+            lines.append("profiler trace windows:")
+            for ev in started:
+                lines.append(
+                    f"  step {ev.get('step', '?')} x{ev.get('steps', '?')} "
+                    f"({ev.get('reason', '?')}) -> {ev.get('dir', '?')}"
+                )
+            lines.append("")
+    cluster_path = os.path.join(run_dir, "metrics_cluster.json")
+    if os.path.isfile(cluster_path):
+        try:
+            with open(cluster_path) as f:
+                cluster = json.load(f)
+        except (OSError, ValueError):
+            cluster = None
+        if cluster and cluster.get("metrics"):
+            series = cluster["metrics"]
+            lines.append(
+                f"cluster metrics roll-up ({cluster.get('hosts', '?')} "
+                f"host(s), {len(series)} series):"
+            )
+            for key in sorted(series)[:40]:
+                val = series[key]
+                if isinstance(val, dict):
+                    parts = f"count={val.get('count', 0)}"
+                    if "total_s" in val:
+                        parts += f" total={val['total_s']:.3f}s"
+                    if "p95_s" in val:
+                        parts += f" p95={val['p95_s'] * 1e3:.1f}ms"
+                    lines.append(f"  {key:44} {parts}")
+                else:
+                    lines.append(f"  {key:44} {val}")
+            if len(series) > 40:
+                lines.append(f"  ... {len(series) - 40} more")
+            lines.append("")
+    return lines
 
 
 def per_node_breakdown(
@@ -244,11 +387,19 @@ def per_node_breakdown(
 
 def main(argv: list[str] | None = None) -> None:
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "top":
+        # the live dashboard: `observe top <dir> [--once] [--interval S]`
+        from keystone_tpu.observe import top as _top
+
+        return _top.main(argv[1:])
     if not argv or argv[0] in ("-h", "--help"):
         raise SystemExit(
             "usage: python -m keystone_tpu observe <run-dir>\n"
+            "       python -m keystone_tpu observe top <run-dir> [--once]"
+            " [--interval S]\n"
             "<run-dir> is a directory containing events.jsonl, or a base\n"
-            "KEYSTONE_OBSERVE_DIR (the newest run under it is rendered)"
+            "KEYSTONE_OBSERVE_DIR (the newest run under it is rendered);\n"
+            "`top` tails steps.jsonl/events.jsonl as a live dashboard"
         )
     try:
         print(render(argv[0]))

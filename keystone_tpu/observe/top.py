@@ -1,0 +1,320 @@
+"""``observe top`` — a curses-free terminal dashboard for a live run.
+
+Tails a run directory's ``steps.jsonl`` (:mod:`.telemetry`) and
+``events.jsonl`` (:mod:`.events`) and refreshes a one-screen summary:
+step rate / tokens-per-sec / MFU, a loss sparkline, per-device HBM
+watermarks, and the resilience / planner decision counters. Pure file
+tailing — it attaches to any live or finished run, local or on a shared
+filesystem, with no jax import and no code running in the trained
+process.
+
+Usage::
+
+    python -m keystone_tpu observe top <dir> [--once] [--interval S]
+
+``--once`` renders one snapshot and exits (tests, CI artifacts, piping
+to a file); otherwise the screen refreshes in place until Ctrl-C.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Any
+
+from keystone_tpu.observe import events as _events
+from keystone_tpu.observe import telemetry as _telemetry
+
+SPARK = "▁▂▃▄▅▆▇█"
+_RATE_WINDOW = 32  # steps the instantaneous rate is averaged over
+_LOSS_WINDOW = 60  # sparkline width
+
+
+class Tail:
+    """Incremental JSONL reader: repeated :meth:`poll` calls parse only
+    bytes appended since the last call, never re-reading the file.
+    Complete lines only — a torn final line is left for the next poll.
+    A truncated/rotated file restarts from the top."""
+
+    def __init__(self, path: str, keep: int = 4096):
+        self.path = path
+        self.offset = 0
+        self.records: list[dict] = []
+        self.keep = keep
+
+    def poll(self) -> list[dict]:
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return self.records
+        if size < self.offset:  # truncated underneath us: start over
+            self.offset, self.records = 0, []
+        if size == self.offset:
+            return self.records
+        with open(self.path, "rb") as f:
+            f.seek(self.offset)
+            chunk = f.read()
+        end = chunk.rfind(b"\n")
+        if end < 0:
+            return self.records
+        self.offset += end + 1
+        for raw in chunk[: end + 1].splitlines():
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                self.records.append(json.loads(raw))
+            except ValueError:
+                continue
+        if len(self.records) > self.keep:
+            del self.records[: len(self.records) - self.keep]
+        return self.records
+
+
+def sparkline(values: list[float], width: int = _LOSS_WINDOW) -> str:
+    vals = [v for v in values[-width:] if isinstance(v, (int, float))]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    return "".join(
+        SPARK[int((v - lo) / span * (len(SPARK) - 1))] for v in vals
+    )
+
+
+def _fmt_bytes(n: float | None) -> str:
+    if not n:
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if n < 1024 or unit == "TiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+    return "-"
+
+
+def _fmt_rate(v: float | None, unit: str = "") -> str:
+    if v is None:
+        return "-"
+    for scale, suffix in ((1e9, "G"), (1e6, "M"), (1e3, "k")):
+        if abs(v) >= scale:
+            return f"{v / scale:.2f} {suffix}{unit}"
+    return f"{v:.2f} {unit}".rstrip()
+
+
+def summarize(steps: list[dict], events: list[dict]) -> dict[str, Any]:
+    """Aggregate the tailed records into the render model (split from
+    rendering so tests and other frontends can assert on it)."""
+    out: dict[str, Any] = {
+        "run": None,
+        "status": "running",
+        "n_events": len(events),
+        "n_steps": 0,
+        "last_step": None,
+        "steps_per_s": None,
+        "tokens_per_s": None,
+        "mfu": None,
+        "loss": None,
+        "losses": [],
+        "devices": [],
+        "hbm_peak_bytes": None,
+        "resilience": {},
+        "plan_decisions": 0,
+        "plan_streams": 0,
+        "trace_windows": 0,
+        "last_ts": None,
+    }
+    # the stream mixes sources: train steps (source="train") carry the
+    # loss/step-rate the header renders; plan chunk streams
+    # (source="plan") ride a process-lifetime sequence, not a step
+    # index, and must not pollute the step-rate math
+    train = [
+        r
+        for r in steps
+        if "step" in r and r.get("source", "train") == "train"
+    ]
+    plan_rows = [r for r in steps if r.get("source") == "plan"]
+    out["plan_streams"] = len(plan_rows)
+    if plan_rows:
+        out["last_ts"] = plan_rows[-1].get("ts")
+    out["n_steps"] = len(train)
+    if train:
+        last = train[-1]
+        out["run"] = last.get("run")
+        out["last_step"] = last.get("step")
+        out["loss"] = last.get("loss")
+        out["tokens_per_s"] = last.get("tokens_per_s")
+        out["mfu"] = last.get("mfu")
+        out["losses"] = [
+            r["loss"] for r in train if isinstance(r.get("loss"), (int, float))
+        ]
+        out["last_ts"] = max(out["last_ts"] or 0, last.get("ts") or 0) or None
+        window = train[-_RATE_WINDOW:]
+        if len(window) >= 2:
+            dt = window[-1].get("ts", 0) - window[0].get("ts", 0)
+            if dt > 0:
+                out["steps_per_s"] = (len(window) - 1) / dt
+        elif last.get("wall_s"):
+            out["steps_per_s"] = 1.0 / last["wall_s"]
+        peaks = [
+            r["hbm_peak_bytes"]
+            for r in train
+            if isinstance(r.get("hbm_peak_bytes"), (int, float))
+        ]
+        if peaks:
+            out["hbm_peak_bytes"] = max(peaks)
+    for ev in events:
+        kind = ev.get("event")
+        if out["run"] is None and ev.get("run"):
+            out["run"] = ev["run"]
+        if ev.get("ts"):
+            out["last_ts"] = max(out["last_ts"] or 0, ev["ts"])
+        if kind == "run_end":
+            out["status"] = ev.get("status") or "done"
+        elif kind == "resilience":
+            action = str(ev.get("action", "?"))
+            out["resilience"][action] = out["resilience"].get(action, 0) + 1
+        elif kind == "optimize":
+            out["plan_decisions"] += len(ev.get("decisions") or []) or 1
+        elif kind == "trace_window":
+            if ev.get("status") == "started":
+                out["trace_windows"] += 1
+        elif kind == "device_memory":
+            out["devices"] = ev.get("devices") or out["devices"]
+            if ev.get("peak_bytes"):
+                out["hbm_peak_bytes"] = max(
+                    out["hbm_peak_bytes"] or 0, ev["peak_bytes"]
+                )
+    return out
+
+
+def render(state: dict[str, Any], run_dir: str) -> str:
+    lines: list[str] = []
+    age = ""
+    if state["last_ts"]:
+        age = f"  last update {max(time.time() - state['last_ts'], 0.0):.1f}s ago"
+    lines.append(
+        f"run {state['run'] or '?'}  [{run_dir}]  "
+        f"status={state['status']}  events={state['n_events']}{age}"
+    )
+    lines.append("")
+    if state["n_steps"]:
+        loss = state["loss"]
+        lines.append(
+            f"steps {state['last_step']}"
+            + (f"  {state['steps_per_s']:.2f} steps/s"
+               if state["steps_per_s"] else "")
+            + (f"  {_fmt_rate(state['tokens_per_s'], 'tok/s')}"
+               if state["tokens_per_s"] else "")
+            + (f"  mfu {state['mfu']:.3f}" if state["mfu"] is not None else "")
+            + (f"  loss {loss:.4f}" if isinstance(loss, (int, float)) else "")
+        )
+        spark = sparkline(state["losses"])
+        if spark:
+            lo = min(state["losses"][-_LOSS_WINDOW:])
+            hi = max(state["losses"][-_LOSS_WINDOW:])
+            lines.append(f"loss  {spark}  [{lo:.3f} .. {hi:.3f}]")
+    else:
+        lines.append("steps (no step telemetry yet)")
+    lines.append("")
+    if state["devices"] or state["hbm_peak_bytes"]:
+        lines.append("hbm watermarks:")
+        for d in state["devices"]:
+            # .get throughout: device_memory events are free-form (any
+            # writer version, or hand-emitted) and the dashboard must
+            # not die mid-watch on a missing field
+            limit = d.get("bytes_limit") or 0
+            peak = d.get("peak_bytes_in_use") or 0
+            pct = (
+                f"  ({100.0 * peak / limit:.0f}% of {_fmt_bytes(limit)})"
+                if limit
+                else ""
+            )
+            lines.append(
+                f"  {d.get('device', '?'):12} "
+                f"in-use {_fmt_bytes(d.get('bytes_in_use')):>10}"
+                f"  peak {_fmt_bytes(peak):>10}{pct}"
+            )
+        if not state["devices"]:
+            lines.append(f"  peak {_fmt_bytes(state['hbm_peak_bytes'])}")
+        lines.append("")
+    if state["resilience"]:
+        pairs = "  ".join(
+            f"{k}={v}" for k, v in sorted(state["resilience"].items())
+        )
+        lines.append(f"resilience: {pairs}")
+    if state["plan_decisions"] or state.get("plan_streams"):
+        parts = []
+        if state["plan_decisions"]:
+            parts.append(f"{state['plan_decisions']} decision(s)")
+        if state.get("plan_streams"):
+            parts.append(f"{state['plan_streams']} chunk stream(s)")
+        lines.append("plan: " + "  ".join(parts))
+    if state["trace_windows"]:
+        lines.append(f"profiler: {state['trace_windows']} trace window(s)")
+    return "\n".join(lines)
+
+
+def resolve_run_dir(path: str) -> str:
+    """Like :func:`events.resolve_run_dir` but also accepts a run that
+    (so far) only has ``steps.jsonl`` — a crashed writer's run must
+    still be inspectable."""
+    try:
+        return _events.resolve_run_dir(path)
+    except (FileNotFoundError, NotADirectoryError):
+        if os.path.isfile(os.path.join(path, _telemetry.STEPS_FILE)):
+            return path
+        candidates = [
+            os.path.join(path, d)
+            for d in (os.listdir(path) if os.path.isdir(path) else ())
+            if os.path.isfile(os.path.join(path, d, _telemetry.STEPS_FILE))
+        ]
+        if not candidates:
+            raise
+        return max(candidates, key=os.path.getmtime)
+
+
+def main(argv: list[str] | None = None) -> None:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    once = "--once" in argv
+    if once:
+        argv.remove("--once")
+    interval = 2.0
+    if "--interval" in argv:
+        i = argv.index("--interval")
+        if i + 1 >= len(argv):
+            raise SystemExit("--interval needs a seconds argument")
+        try:
+            interval = float(argv[i + 1])
+        except ValueError:
+            raise SystemExit(
+                f"--interval: bad seconds value {argv[i + 1]!r}"
+            ) from None
+        del argv[i : i + 2]
+    if not argv or argv[0] in ("-h", "--help"):
+        raise SystemExit(
+            "usage: python -m keystone_tpu observe top <run-dir> "
+            "[--once] [--interval S]\n"
+            "<run-dir> is a directory containing steps.jsonl/events.jsonl,"
+            "\nor a base KEYSTONE_OBSERVE_DIR (the newest run is tailed)"
+        )
+    try:
+        run_dir = resolve_run_dir(argv[0])
+    except OSError as e:
+        raise SystemExit(str(e)) from None
+    steps = Tail(os.path.join(run_dir, _telemetry.STEPS_FILE))
+    events = Tail(os.path.join(run_dir, _events.EVENTS_FILE))
+    while True:
+        state = summarize(steps.poll(), events.poll())
+        screen = render(state, run_dir)
+        if once:
+            print(screen)
+            return
+        # ANSI clear + home: refresh in place without curses
+        sys.stdout.write("\x1b[2J\x1b[H" + screen + "\n")
+        sys.stdout.flush()
+        try:
+            time.sleep(interval)
+        except KeyboardInterrupt:
+            return

@@ -17,6 +17,8 @@ that executes the pipeline, which is what the launcher flag does):
 
 from __future__ import annotations
 
+import itertools
+import json
 import os
 
 import jax
@@ -25,6 +27,15 @@ import numpy as np
 from keystone_tpu.core.logging import get_logger
 
 logger = get_logger("keystone_tpu.parallel.multihost")
+
+#: merged cluster metrics written by :func:`rollup_metrics` on host 0,
+#: rendered by ``python -m keystone_tpu observe <run-dir>``
+CLUSTER_METRICS_FILE = "metrics_cluster.json"
+
+# per-process roll-up sequence: every host calls rollup_metrics in the
+# same program order (SPMD), so the counter yields matching KV keys and
+# barrier ids without any extra coordination
+_rollup_seq = itertools.count()
 
 #: env override for :func:`initialize`'s ``init_timeout_s``.
 ENV_INIT_TIMEOUT = "KEYSTONE_INIT_TIMEOUT_S"
@@ -130,6 +141,220 @@ def _preflight_coordinator(
         "(process 0) must be running and reachable before workers join. "
         f"Last error: {last!r}"
     )
+
+
+def merge_metric_dumps(dumps: list[dict]) -> dict:
+    """Merge per-host kind-tagged metric dumps
+    (:meth:`keystone_tpu.observe.metrics.MetricsRegistry.dump`) into
+    cluster totals: counters sum, gauges take the max (watermark
+    semantics — the cluster's HBM peak is the worst host's peak), timers
+    pool count/total/min/max and recompute percentiles from the pooled
+    reservoirs rather than averaging per-host quantiles.
+
+    Returns a snapshot-shaped dict (series key → number, or summary dict
+    for timers) ready for a report to render.
+    """
+    from keystone_tpu.observe.metrics import percentiles
+
+    acc: dict[str, dict] = {}
+    for dump in dumps:
+        for key, entry in (dump or {}).items():
+            if not isinstance(entry, dict):
+                continue
+            kind = entry.get("kind", "counter")
+            cur = acc.get(key)
+            if cur is None:
+                cur = dict(entry)
+                if kind == "timer":
+                    cur["samples"] = list(entry.get("samples") or [])
+                acc[key] = cur
+                continue
+            if kind == "counter":
+                cur["value"] = cur.get("value", 0) + entry.get("value", 0)
+            elif kind == "gauge":
+                cur["value"] = max(
+                    cur.get("value", 0.0), entry.get("value", 0.0)
+                )
+            else:  # timer
+                n_cur, n_new = cur.get("count", 0), entry.get("count", 0)
+                cur["count"] = n_cur + n_new
+                cur["total_s"] = cur.get("total_s", 0.0) + entry.get(
+                    "total_s", 0.0
+                )
+                mins = [
+                    d["min_s"]
+                    for d, n in ((cur, n_cur), (entry, n_new))
+                    if n and "min_s" in d
+                ]
+                if mins:
+                    cur["min_s"] = min(mins)
+                cur["max_s"] = max(
+                    cur.get("max_s", 0.0), entry.get("max_s", 0.0)
+                )
+                cur["samples"].extend(entry.get("samples") or [])
+    out: dict[str, object] = {}
+    for key, entry in acc.items():
+        if entry.get("kind") == "timer":
+            samples = entry.pop("samples", [])
+            entry.pop("kind", None)
+            if entry.get("count"):
+                entry["mean_s"] = entry["total_s"] / entry["count"]
+            for pkey in ("p50_s", "p95_s", "p99_s"):
+                entry.pop(pkey, None)
+            if samples:
+                p = percentiles(samples, (50, 95, 99))
+                entry.update(p50_s=p[50], p95_s=p[95], p99_s=p[99])
+            out[key] = entry
+        else:
+            out[key] = entry.get("value")
+    return out
+
+
+def _coordination_client():
+    """The jax coordination-service KV client for this process, or None
+    when ``jax.distributed`` was never initialized. Private jax surface
+    (``jax._src.distributed``) by necessity — there is no public KV API
+    — so every caller treats None/AttributeError as "transport
+    unavailable" and degrades."""
+    try:
+        from jax._src import distributed as _dist
+
+        return getattr(_dist.global_state, "client", None)
+    except Exception:  # noqa: BLE001 — jax refactor moved the module
+        return None
+
+
+def _gather_dumps(
+    payload: str, pid: int, nprocs: int, timeout_s: float
+) -> list[dict] | None:
+    """Gather every host's serialized metrics dump onto host 0. Primary
+    transport: the coordination-service KV store (works wherever
+    ``jax.distributed`` init works — including CPU test rigs whose XLA
+    build has no multiprocess collectives). Fallback: a padded uint8
+    ``process_allgather`` over device collectives. Returns the dump list
+    on host 0, None on other hosts and on total transport failure."""
+    client = _coordination_client()
+    seq = next(_rollup_seq)
+    if client is not None:
+        # No cross-path fallback here: whether a coordination-service
+        # client exists IS cluster-consistent (jax.distributed init), but
+        # a mid-path failure on one host is not — if host 0 alone fell
+        # through to the collective below after the barrier passed, it
+        # would block forever in an allgather no other host joins.
+        # Degrading to per-host metrics is the safe failure.
+        try:
+            client.key_value_set(f"keystone/metrics/{seq}/{pid}", payload)
+            client.wait_at_barrier(
+                f"keystone_metrics_rollup_{seq}", int(timeout_s * 1000)
+            )
+            if pid != 0:
+                return None
+            dumps = [
+                json.loads(
+                    client.blocking_key_value_get(
+                        f"keystone/metrics/{seq}/{i}",
+                        int(timeout_s * 1000),
+                    )
+                )
+                for i in range(nprocs)
+            ]
+            try:
+                # reclaim the payloads: a long-lived job rolling up
+                # periodically must not grow the coordinator's memory
+                # by one dump per host per call
+                client.key_value_delete(f"keystone/metrics/{seq}/")
+            except Exception:  # noqa: BLE001 — older jaxlib, best-effort
+                pass
+            return dumps
+        except Exception as e:  # noqa: BLE001 — degraded, never fatal
+            logger.warning(
+                "metrics roll-up over the coordination service failed "
+                "(%r); each host keeps only its own metrics",
+                e,
+            )
+            return None
+    try:
+        from jax.experimental import multihost_utils
+
+        blob = np.frombuffer(payload.encode(), np.uint8)
+        lens = np.asarray(
+            multihost_utils.process_allgather(
+                np.array([blob.size], np.int32)
+            )
+        ).reshape(nprocs)
+        padded = np.zeros(int(lens.max()), np.uint8)
+        padded[: blob.size] = blob
+        gathered = np.asarray(multihost_utils.process_allgather(padded))
+        if pid != 0:
+            return None
+        return [
+            json.loads(bytes(gathered[i, : int(lens[i])]).decode())
+            for i in range(nprocs)
+        ]
+    except Exception as e:  # noqa: BLE001 — degraded, never fatal
+        logger.warning(
+            "metrics roll-up failed (%r); each host keeps only its own "
+            "metrics",
+            e,
+        )
+        return None
+
+
+def rollup_metrics(
+    out_dir: str | None = None, timeout_s: float = 60.0
+) -> dict | None:
+    """Cluster-wide metrics roll-up: every host serializes its metrics
+    registry dump, host 0 gathers and merges them (counters summed,
+    gauge watermarks maxed, timer reservoirs pooled) so a run report
+    shows cluster totals instead of host-0-only numbers.
+
+    ALL hosts must call this (it synchronizes at a barrier) — the
+    launcher does so after a ``--multihost`` pipeline returns. Host 0
+    writes ``metrics_cluster.json`` under ``out_dir`` (when given) and
+    emits a ``metrics_rollup`` event; it returns the merged dict. Other
+    hosts return None. Transport failure degrades to a warning and None
+    — observability must not take down the run it watches."""
+    from keystone_tpu.observe import events as _events
+    from keystone_tpu.observe import metrics as _metrics
+
+    try:
+        nprocs = jax.process_count()
+        pid = jax.process_index()
+    except Exception:  # noqa: BLE001 — backend init failure
+        nprocs, pid = 1, 0
+    local = {"process": pid, "metrics": _metrics.get_registry().dump()}
+    if nprocs == 1:
+        dumps: list[dict] | None = [local]
+    else:
+        dumps = _gather_dumps(json.dumps(local), pid, nprocs, timeout_s)
+        if dumps is None:
+            return None
+    merged = {
+        "hosts": nprocs,
+        "metrics": merge_metric_dumps([d.get("metrics", {}) for d in dumps]),
+    }
+    if out_dir:
+        try:
+            path = os.path.join(out_dir, CLUSTER_METRICS_FILE)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(merged, f, indent=1)
+            os.replace(tmp, path)
+        except OSError as e:
+            logger.warning(
+                "cannot write %s under %s (%r)",
+                CLUSTER_METRICS_FILE,
+                out_dir,
+                e,
+            )
+    log = _events.active()
+    if log is not None:
+        log.emit(
+            "metrics_rollup",
+            hosts=nprocs,
+            series=len(merged["metrics"]),
+        )
+    return merged
 
 
 def global_batch_from_local(local_batch: np.ndarray, mesh, ndim: int | None = None):

@@ -28,6 +28,49 @@ import numpy as np
 from keystone_tpu.observe import cost as _cost
 from keystone_tpu.plan.ir import NodeCost, PlanNode
 
+# Roofline peaks per device kind — THE single home (``observe/report.py``
+# and ``plan/ir.py`` re-export from here, so the report's vs_peak column
+# and the planner's recompute/transfer estimates can never quote
+# different chips): (bf16 MXU peak FLOP/s, HBM bytes/s, host→device
+# bytes/s over PCIe, collective bytes/s over ICI), keyed by a
+# ``device_kind`` substring. Basis: ROOFLINE.md (one v5e chip ≈ 197 TF/s
+# bf16, HBM ≈ 819 GB/s; the f32 MXU rate is lower, so f32 workloads
+# report conservative MFU). The "cpu" row is a coarse fallback: the
+# planner only compares relative magnitudes there, and the report shows
+# ``-`` for vs_peak (``peak_flops_for`` returns None off-TPU).
+DEVICE_PEAKS: dict[str, tuple[float, float, float, float]] = {
+    "cpu": (5e10, 2e10, 2e10, 2e10),
+    "v4": (2.75e14, 1.2e12, 3.2e10, 3e11),
+    "v5 lite": (1.97e14, 8.19e11, 3.2e10, 1.6e11),
+    "v5e": (1.97e14, 8.19e11, 3.2e10, 1.6e11),
+    "v5p": (4.59e14, 2.76e12, 3.2e10, 4.8e11),
+}
+
+
+def device_peaks(
+    device_kind: str | None,
+) -> tuple[float, float, float, float]:
+    """The peak tuple for a jax ``device_kind`` string (substring match,
+    case-insensitive); unknown kinds fall back to the coarse "cpu" row."""
+    if device_kind:
+        kind = device_kind.lower()
+        for key, peaks in DEVICE_PEAKS.items():
+            if key in kind:
+                return peaks
+    return DEVICE_PEAKS["cpu"]
+
+
+def peak_flops_for(device_kind: str | None) -> float | None:
+    """bf16 peak FLOP/s for a known accelerator ``device_kind``, or None
+    (CPU, new chip generations) — the report's roofline basis."""
+    if not device_kind:
+        return None
+    kind = device_kind.lower()
+    for key, peaks in DEVICE_PEAKS.items():
+        if key != "cpu" and key in kind:
+            return peaks[0]
+    return None
+
 
 def _rows(batch: Any) -> int:
     leaves = jax.tree_util.tree_leaves(batch)

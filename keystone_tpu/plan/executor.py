@@ -32,6 +32,8 @@ once.
 
 from __future__ import annotations
 
+import itertools
+import time
 from typing import Any, Callable, Sequence
 
 import jax
@@ -52,7 +54,12 @@ from keystone_tpu.core.pipeline import (
 )
 from keystone_tpu.observe import events as _events
 from keystone_tpu.observe import metrics as _metrics
+from keystone_tpu.observe import telemetry as _telemetry
 from keystone_tpu.plan.ir import Plan, PlanNode
+
+# monotone id for chunk-stream telemetry records (steps.jsonl rows with
+# source="plan" — a planned pass has no train-step index to ride on)
+_stream_seq = itertools.count(1)
 
 
 def _chunkable_node(node: Any) -> bool:
@@ -203,6 +210,11 @@ def _run_chain(
             # even shard shapes — the planner rounds, this guards
             if sharding is not None and plan.chunk_size % shards:
                 sharding = None
+            # live telemetry: one steps.jsonl record per chunked segment
+            # stream, plus the staged-depth / in-flight gauges the
+            # dashboard reads. One global read when no sink is active.
+            steplog = _telemetry.active_step_log()
+            t0 = time.perf_counter()
             out = apply_in_chunks(
                 lambda b, p=seg_pipe: jit_apply(p, b),
                 out,
@@ -215,6 +227,24 @@ def _run_chain(
             reg.counter("plan_chunked_executions").inc()
             if sharding is not None:
                 reg.counter("plan_shard_dispatches").inc()
+            if steplog is not None:
+                reg.gauge("plan_inflight").set(float(max(plan.prefetch, 0)))
+                reg.gauge("plan_stage_depth").set(float(plan.stage_depth))
+                wall = time.perf_counter() - t0
+                rows = int(getattr(prev, "shape", (0,))[0] or 0)
+                flops = sum(pn.cost.flops for pn in seg) * rows
+                steplog.step(
+                    step=next(_stream_seq),
+                    source="plan",
+                    wall_s=wall,
+                    flops=flops or None,
+                    rows=rows,
+                    rows_per_s=round(rows / wall, 3) if wall else None,
+                    chunks=-(-rows // plan.chunk_size) if rows else 0,
+                    chunk_size=plan.chunk_size,
+                    stage_depth=plan.stage_depth,
+                    inflight=max(plan.prefetch, 0),
+                )
         else:
             out = jit_apply(seg_pipe, out)
         if seg[-1].materialize or isinstance(seg[-1].op, Cacher):

@@ -23,29 +23,26 @@ from typing import Any
 from keystone_tpu.core.pipeline import Cacher, Pipeline, Transformer
 from keystone_tpu.observe import events as _events
 
-# Roofline constants used to turn a compiler cost profile into seconds
-# when no measured wall time exists: (peak FLOP/s, peak HBM bytes/s,
-# host→device bytes/s over PCIe, collective bytes/s over ICI) per device
-# kind. Deliberately coarse — the planner compares operators against
-# each other and against residency/transfer penalties, so only relative
-# magnitudes matter. Unknown device kinds fall back to "cpu" (whose
-# "transfer" is a host memcpy and "ICI" a NUMA hop — same order as HBM).
-DEVICE_PEAKS: dict[str, tuple[float, float, float, float]] = {
-    "cpu": (5e10, 2e10, 2e10, 2e10),
-    "TPU v4": (2.75e14, 1.2e12, 3.2e10, 3e11),
-    "TPU v5 lite": (3.94e14, 8.1e11, 3.2e10, 1.6e11),
-    "TPU v5e": (3.94e14, 8.1e11, 3.2e10, 1.6e11),
-}
-
-
-def device_peaks(
+# The roofline table (peak FLOP/s, HBM B/s, PCIe B/s, ICI B/s per device
+# kind) lives in ONE place: :data:`keystone_tpu.plan.costs.DEVICE_PEAKS`
+# (the observe report prices its vs_peak column off the same rows).
+# ``costs`` imports this module at module level, so the hop back is
+# function-local; the module ``__getattr__`` below keeps the historical
+# ``plan.ir.DEVICE_PEAKS`` / ``plan.ir.device_peaks`` names importable.
+def _device_peaks(
     device_kind: str | None,
 ) -> tuple[float, float, float, float]:
-    if device_kind:
-        for kind, peaks in DEVICE_PEAKS.items():
-            if kind.lower() in device_kind.lower():
-                return peaks
-    return DEVICE_PEAKS["cpu"]
+    from keystone_tpu.plan.costs import device_peaks
+
+    return device_peaks(device_kind)
+
+
+def __getattr__(name: str):
+    if name in ("DEVICE_PEAKS", "device_peaks"):
+        from keystone_tpu.plan import costs as _costs
+
+        return getattr(_costs, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 @dataclasses.dataclass
@@ -81,7 +78,7 @@ class NodeCost:
         """Estimated seconds to (re)compute this node over ``rows`` rows."""
         if self.wall_s is not None:
             return self.wall_s * rows
-        peak_flops, peak_bw, _, _ = device_peaks(device_kind)
+        peak_flops, peak_bw, _, _ = _device_peaks(device_kind)
         return max(
             self.flops * rows / peak_flops,
             self.bytes_accessed * rows / peak_bw,
@@ -91,7 +88,7 @@ class NodeCost:
         """Estimated seconds to move this node's input host→device
         (PCIe) for ``rows`` rows — the staging transfer the executor
         tries to hide behind compute."""
-        _, _, h2d_bw, _ = device_peaks(device_kind)
+        _, _, h2d_bw, _ = _device_peaks(device_kind)
         return self.input_bytes * rows / h2d_bw
 
     def collective_s(
@@ -99,7 +96,7 @@ class NodeCost:
     ) -> float:
         """Estimated seconds this node spends in cross-shard collectives
         (ICI psum) when executed sharded over ``rows`` rows."""
-        _, _, _, ici_bw = device_peaks(device_kind)
+        _, _, _, ici_bw = _device_peaks(device_kind)
         return self.collective_bytes * rows / ici_bw
 
 

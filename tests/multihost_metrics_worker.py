@@ -1,0 +1,72 @@
+"""Worker program for the multihost metrics roll-up test.
+
+NOT a test module (no ``test_`` prefix): ``test_observe.py`` launches two
+copies — each host records its own metrics (distinct counter values,
+timers, gauges), then every host calls
+``multihost.rollup_metrics(out_dir)``; host 0 gathers the per-host
+snapshots over the jax coordination service, merges them, and writes
+``metrics_cluster.json`` so a report shows cluster totals instead of
+host-0-only numbers.
+
+Exit codes: 0 ok; 42 the rig cannot even join a 2-process jax.distributed
+runtime (the launcher test skips — same environments where
+test_multihost.py cannot run); any other code is a real failure.
+
+Usage: python multihost_metrics_worker.py <process_id> <num_processes>
+       <port> <out_dir>
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> None:
+    pid, nprocs, port, out_dir = (
+        int(sys.argv[1]),
+        int(sys.argv[2]),
+        sys.argv[3],
+        sys.argv[4],
+    )
+    from keystone_tpu.observe import metrics
+    from keystone_tpu.parallel import multihost
+
+    try:
+        multihost.initialize(
+            coordinator_address=f"localhost:{port}",
+            num_processes=nprocs,
+            process_id=pid,
+            init_timeout_s=60,
+        )
+    except RuntimeError as e:
+        print(f"INIT_FAILED: {e}", flush=True)
+        sys.exit(42)
+    assert jax.process_count() == nprocs, jax.process_count()
+
+    # distinct per-host metric values so the merged totals are provably
+    # cross-host, not host-0's numbers relabeled
+    reg = metrics.get_registry()
+    reg.counter("mh_rows").inc(100 * (pid + 1))  # -> 300 for 2 hosts
+    reg.counter("mh_calls", host=str(pid)).inc(pid + 1)
+    reg.gauge("mh_hbm_peak").set(float(1000 * (pid + 1)))  # merge: max
+    t = reg.timer("mh_step_seconds")
+    for k in range(10):
+        t.observe(0.010 * (pid + 1) + 0.001 * k)
+
+    merged = multihost.rollup_metrics(out_dir)
+    if pid == 0:
+        assert merged is not None, "host 0 got no merged roll-up"
+        assert merged["hosts"] == nprocs, merged
+    else:
+        assert merged is None, "non-zero host should not hold the merge"
+    print(f"worker {pid}: ok", flush=True)
+
+
+if __name__ == "__main__":
+    main()

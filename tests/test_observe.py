@@ -426,3 +426,420 @@ def test_events_file_lines_are_valid_json(tmp_path):
     with open(os.path.join(run_dir, events.EVENTS_FILE)) as f:
         for line in f:
             json.loads(line)
+
+
+# ------------------------------------------------- live telemetry (PR 5)
+
+
+def test_steplog_writes_steps_jsonl_and_derives_rates(tmp_path):
+    from keystone_tpu.observe import telemetry
+
+    with events.run(str(tmp_path)) as log:
+        sl = telemetry.active_step_log()
+        assert sl is not None and telemetry.active_step_log() is sl  # bound once
+        sl.step(step=1, loss=2.5, tokens=1000, wall_s=0.5, flops=1e9)
+        sl.step(step=2, loss=2.0, tokens=1000, wall_s=0.25,
+                hbm_peak_bytes=123456)
+        run_dir = log.run_dir
+    recs = events.read_jsonl(os.path.join(run_dir, "steps.jsonl"))
+    assert [r["step"] for r in recs] == [1, 2]
+    assert recs[0]["loss"] == 2.5
+    assert recs[0]["tokens_per_s"] == pytest.approx(2000.0)
+    assert recs[0]["tflops_per_s"] == pytest.approx(2e-3)
+    assert recs[0]["mfu"] > 0  # priced off plan.costs.DEVICE_PEAKS
+    assert recs[1]["hbm_peak_bytes"] == 123456
+    assert all(r["run"] == recs[0]["run"] for r in recs)
+    # the stream also feeds the metrics registry for dashboards
+    snap = metrics.get_registry().snapshot()
+    assert snap["telemetry_last_step{source=train}"] == 2.0
+
+
+def test_steplog_no_sink_one_global_read_no_io(monkeypatch):
+    from keystone_tpu.observe import telemetry
+
+    assert events.active() is None  # suite invariant: no ambient sink
+    reads = []
+    monkeypatch.setattr(
+        telemetry._events, "active", lambda: reads.append(1) or None
+    )
+
+    def boom(self, *a, **k):  # constructing a StepLog would mean file I/O
+        raise AssertionError("StepLog built with no sink active")
+
+    monkeypatch.setattr(telemetry.StepLog, "__init__", boom)
+    assert telemetry.active_step_log() is None
+    assert len(reads) == 1  # exactly ONE global read on the hot path
+
+
+def test_lm_train_emits_step_telemetry(tmp_path):
+    """Acceptance: an LM run with a sink active produces per-step
+    loss/tokens-per-sec/MFU records in steps.jsonl."""
+    import jax
+
+    from keystone_tpu.models import lm_transformer as lm
+
+    corpus = lm.synthetic_corpus(512, 64, seed=0)
+    model = lm.TransformerLM.create(
+        jax.random.key(0), vocab=64, max_seq=16, dim=32, depth=1,
+        num_heads=2,
+    )
+    with events.run(str(tmp_path)) as log:
+        model, losses = lm.train(
+            model, corpus, steps=3, batch=4, seq=16, lr=1e-3
+        )
+        run_dir = log.run_dir
+    recs = [
+        r
+        for r in events.read_jsonl(os.path.join(run_dir, "steps.jsonl"))
+        if r.get("source") == "train"
+    ]
+    assert [r["step"] for r in recs] == [1, 2, 3]
+    assert [r["loss"] for r in recs] == pytest.approx(losses)
+    assert all(
+        r["tokens"] == 64 and r["tokens_per_s"] > 0 and r["mfu"] > 0
+        and r["wall_s"] > 0
+        for r in recs
+    )
+
+
+def test_plan_chunked_execution_records_stream_telemetry(tmp_path):
+    from keystone_tpu.observe import telemetry
+    from keystone_tpu.plan.ir import Plan, chain_from
+    from keystone_tpu.plan.executor import run_plan
+
+    pipe = three_node_pipe()
+    x = jnp.ones((32, 4))
+    expect = np.asarray(pipe(x))
+    plan = Plan(prefix=chain_from(pipe), chunk_size=8)
+    with events.run(str(tmp_path)) as log:
+        got = np.asarray(run_plan(plan, x))
+        run_dir = log.run_dir
+    assert np.array_equal(got, expect)
+    recs = [
+        r
+        for r in events.read_jsonl(os.path.join(run_dir, "steps.jsonl"))
+        if r.get("source") == "plan"
+    ]
+    assert recs and recs[0]["rows"] == 32 and recs[0]["chunks"] == 4
+    assert recs[0]["chunk_size"] == 8 and recs[0]["rows_per_s"] > 0
+    snap = metrics.get_registry().snapshot()
+    assert snap.get("plan_stage_depth") is not None
+
+
+def test_timer_percentiles_from_bounded_reservoir():
+    t = metrics.Timer()
+    for ms in range(1, 101):  # 1..100 ms
+        t.observe(ms / 1e3)
+    s = t.summary()
+    assert s["p50_s"] == pytest.approx(0.050, abs=0.002)
+    assert s["p95_s"] == pytest.approx(0.095, abs=0.002)
+    assert s["p99_s"] == pytest.approx(0.099, abs=0.002)
+    assert t.percentile(50) == s["p50_s"]
+    # reservoir stays bounded on long runs
+    for _ in range(5000):
+        t.observe(0.01)
+    assert len(t.samples) <= metrics._RESERVOIR_CAP
+    assert t.count == 5100
+
+
+def test_series_key_escapes_label_values_roundtrip():
+    hostile = "Node{f=g, h}, x=1"
+    key = metrics._series_key("calls", {"node": hostile, "k": "plain"})
+    name, labels = metrics.parse_series_key(key)
+    assert name == "calls"
+    assert labels == {"node": hostile, "k": "plain"}
+    # two hostile values that would collide unescaped stay distinct
+    k1 = metrics._series_key("c", {"a": "x,b=y"})
+    k2 = metrics._series_key("c", {"a": "x", "b": "y"})
+    assert k1 != k2
+    # plain keys are unchanged (snapshot stability)
+    assert metrics._series_key("calls", {"node": "00:add1"}) == (
+        "calls{node=00:add1}"
+    )
+    reg = metrics.MetricsRegistry()
+    reg.counter("calls", node=hostile).inc()
+    assert reg.counter("calls", node=hostile).value == 1
+
+
+def test_read_events_tolerates_torn_final_line(tmp_path):
+    import logging
+
+    with events.run(str(tmp_path)) as log:
+        log.emit("node", node="00:x", wall_s=0.1, status="ok")
+        run_dir = log.run_dir
+    path = os.path.join(run_dir, events.EVENTS_FILE)
+    whole = open(path).read()
+    # SIGKILL mid-write: the final record is torn mid-JSON, no newline
+    with open(path, "w") as f:
+        f.write(whole + '{"ts": 123456.0, "run": "abc", "event": "nod')
+    records = []
+    handler = logging.Handler()
+    handler.emit = lambda rec: records.append(rec)
+    logger = logging.getLogger("keystone_tpu.observe")
+    logger.addHandler(handler)
+    try:
+        evs = events.read_events(run_dir)
+    finally:
+        logger.removeHandler(handler)
+    kinds = [e["event"] for e in evs]
+    assert kinds[0] == "run_start" and "node" in kinds  # intact records kept
+    assert len(evs) == len(whole.splitlines())  # torn tail: skipped, not raised
+    assert not any(e.get("run") == "abc" for e in evs)
+    assert any("unparseable" in r.getMessage() for r in records)  # warned
+
+
+def test_device_memory_sampler_degrades_on_cpu_and_tracks_watermarks(
+    monkeypatch,
+):
+    from keystone_tpu.observe import devices as obs_devices
+
+    # CPU backend: memory_stats() is None -> empty sample, no crash
+    mon = obs_devices.DeviceMemoryMonitor()
+    assert obs_devices.sample_device_memory() == []
+    assert mon.sample() == []
+    assert mon.peak_bytes() is None and mon.maybe_sample() is None
+
+    # fake accelerator stats: watermark ratchets up, never down
+    current = {"v": 100}
+
+    def fake_stats(dev):
+        v = current["v"]
+        return {"bytes_in_use": v, "peak_bytes_in_use": v, "bytes_limit": 1000}
+
+    monkeypatch.setattr(obs_devices, "_device_stats", fake_stats)
+    mon = obs_devices.DeviceMemoryMonitor(emit_events=False)
+    mon.sample()
+    assert mon.peak_bytes() == 100
+    current["v"] = 900
+    mon.sample()
+    assert mon.peak_bytes() == 900
+    current["v"] = 300
+    mon.sample()
+    assert mon.peak_bytes() == 900  # a lower sample can't lower the peak
+    dev0 = next(iter(mon.watermarks))
+    snap = metrics.get_registry().snapshot()
+    assert snap[f"hbm_peak_bytes{{device={dev0}}}"] == 900.0
+
+
+def test_observe_top_once_cli_smoke(tmp_path, capsys):
+    from keystone_tpu.__main__ import main as cli_main
+    from keystone_tpu.observe import telemetry
+
+    with events.run(str(tmp_path)) as log:
+        sl = telemetry.active_step_log()
+        for i in range(5):
+            sl.step(step=i + 1, loss=3.0 - 0.1 * i, tokens=256,
+                    wall_s=0.01, flops=1e9)
+        log.emit(
+            "device_memory",
+            devices=[{
+                "device": "tpu:0", "kind": "TPU v5 lite",
+                "bytes_in_use": 2 << 30, "peak_bytes_in_use": 3 << 30,
+                "bytes_limit": 16 << 30,
+            }],
+            peak_bytes=3 << 30,
+        )
+        from keystone_tpu.resilience.emit import decision
+
+        decision("retry", label="unit")
+        run_dir = log.run_dir
+    cli_main(["observe", "top", run_dir, "--once"])
+    out = capsys.readouterr().out
+    assert "steps 5" in out
+    assert "loss" in out and "2.6" in out  # last loss rendered
+    assert "tpu:0" in out and "peak" in out  # HBM watermark line
+    assert "retry=1" in out  # resilience counter
+    # base-dir form resolves to the newest run
+    cli_main(["observe", "top", str(tmp_path), "--once"])
+    assert "steps 5" in capsys.readouterr().out
+    # usage
+    with pytest.raises(SystemExit):
+        cli_main(["observe", "top"])
+
+
+def test_top_and_report_keep_plan_stream_out_of_step_stats(tmp_path):
+    """Plan chunk-stream records (source="plan") ride a process-lifetime
+    sequence and whole-stream walls — they must not pollute the train
+    step rate/percentiles in `observe top` or the report."""
+    from keystone_tpu.observe import report as observe_report
+    from keystone_tpu.observe import telemetry
+    from keystone_tpu.observe.top import summarize as top_summarize
+
+    with events.run(str(tmp_path)) as log:
+        sl = telemetry.active_step_log()
+        for i in range(4):
+            sl.step(step=i + 1, loss=2.0 - 0.1 * i, tokens=128, wall_s=0.01)
+        # a plan stream lands between train steps: huge wall, global seq
+        sl.step(step=9001, source="plan", wall_s=30.0, rows=4096,
+                rows_per_s=136.5, chunks=8, chunk_size=512)
+        run_dir = log.run_dir
+    steps = events.read_jsonl(os.path.join(run_dir, "steps.jsonl"))
+    state = top_summarize(steps, events.read_events(run_dir))
+    assert state["last_step"] == 4  # not the plan stream's 9001
+    assert state["n_steps"] == 4
+    assert state["plan_streams"] == 1
+    assert len(state["losses"]) == 4
+    text = observe_report.render(run_dir)
+    assert "4 step record(s), last step 4" in text
+    # per-step p99 stays in the per-step regime (ms), not the plan
+    # stream's 30 s wall
+    assert "p99 10.0 ms" in text
+    assert "plan chunk streams: 1 record(s), 4096 row(s)" in text
+
+
+def test_step_tracer_env_windows_and_sigusr2(monkeypatch, tmp_path):
+    from keystone_tpu.observe import tracing
+
+    calls = []
+    monkeypatch.setattr(
+        jax.profiler, "start_trace", lambda d: calls.append(("start", d))
+    )
+    monkeypatch.setattr(
+        jax.profiler, "stop_trace", lambda: calls.append(("stop",))
+    )
+    monkeypatch.setenv(tracing.ENV_PROFILE_STEPS, "3:2")
+    tracer = tracing.StepTracer.from_env(log_dir=str(tmp_path))
+    assert tracer is not None
+    for i in range(8):
+        tracer.step(i)
+    assert [c[0] for c in calls] == ["start", "stop"]
+    assert calls[0][1] == os.path.join(str(tmp_path), "step_3")
+    # SIGUSR2-style on-demand window: armed flag fires at the next step
+    calls.clear()
+    tracer.request(steps=1)
+    tracer.step(8)
+    tracer.step(9)
+    assert [c[0] for c in calls] == ["start", "stop"]
+    assert calls[0][1] == os.path.join(str(tmp_path), "step_8")
+    # a request landing MID-window stays armed and fires at the first
+    # free step boundary instead of being silently dropped
+    calls.clear()
+    tracer.request(steps=2)
+    tracer.step(10)  # starts the on-demand window (steps 10-11)
+    tracer.request(steps=1)  # arrives while the window is active
+    tracer.step(11)
+    tracer.step(12)  # first free boundary: pending request fires here
+    tracer.step(13)
+    assert [c[0] for c in calls] == ["start", "stop", "start", "stop"]
+    assert calls[2][1] == os.path.join(str(tmp_path), "step_12")
+    tracer.close()
+    # malformed spec: windows dropped with a warning, not a crash
+    monkeypatch.setenv(tracing.ENV_PROFILE_STEPS, "nonsense")
+    assert tracing.StepTracer.from_env(log_dir=str(tmp_path)) is None
+    with pytest.raises(ValueError):
+        tracing.parse_windows("12")
+    with pytest.raises(ValueError):
+        tracing.parse_windows("5:0")
+    assert tracing.parse_windows("120:10,5:1") == [(5, 1), (120, 10)]
+
+
+def test_step_tracer_degrades_when_profiler_unavailable(monkeypatch, tmp_path):
+    from keystone_tpu.observe import tracing
+
+    def broken(d):
+        raise RuntimeError("profiler busy")
+
+    monkeypatch.setattr(jax.profiler, "start_trace", broken)
+    tracer = tracing.StepTracer(windows=[(0, 2)], log_dir=str(tmp_path))
+    for i in range(4):
+        tracer.step(i)  # must not raise
+    tracer.close()
+
+
+def test_metrics_dump_merge_cluster_totals():
+    from keystone_tpu.parallel.multihost import merge_metric_dumps
+
+    a, b = metrics.MetricsRegistry(), metrics.MetricsRegistry()
+    a.counter("rows").inc(100)
+    b.counter("rows").inc(200)
+    a.gauge("hbm_peak").set(1000.0)
+    b.gauge("hbm_peak").set(2000.0)
+    for k in range(10):
+        a.timer("step_s").observe(0.010 + 0.001 * k)
+        b.timer("step_s").observe(0.020 + 0.001 * k)
+    merged = merge_metric_dumps([a.dump(), b.dump()])
+    assert merged["rows"] == 300  # counters sum
+    assert merged["hbm_peak"] == 2000.0  # gauges: cluster max (watermark)
+    t = merged["step_s"]
+    assert t["count"] == 20
+    assert t["min_s"] == pytest.approx(0.010)
+    assert t["max_s"] == pytest.approx(0.029)
+    # percentiles come from POOLED samples: p95 must sit in host b's range
+    assert 0.020 <= t["p95_s"] <= 0.029
+
+
+def test_rollup_metrics_single_host_writes_cluster_file(tmp_path):
+    from keystone_tpu.parallel.multihost import rollup_metrics
+
+    metrics.get_registry().counter("rollup_unit_rows").inc(7)
+    with events.run(str(tmp_path)) as log:
+        merged = rollup_metrics(log.run_dir)
+        run_dir = log.run_dir
+    assert merged is not None and merged["hosts"] == 1
+    assert merged["metrics"]["rollup_unit_rows"] == 7
+    with open(os.path.join(run_dir, "metrics_cluster.json")) as f:
+        on_disk = json.load(f)
+    assert on_disk["metrics"]["rollup_unit_rows"] == 7
+    rolls = [
+        e for e in events.read_events(run_dir)
+        if e["event"] == "metrics_rollup"
+    ]
+    assert rolls and rolls[0]["hosts"] == 1
+    # the report renders the roll-up section
+    from keystone_tpu.observe.report import render
+
+    assert "cluster metrics roll-up" in render(run_dir)
+
+
+def test_multihost_metrics_rollup_two_processes(tmp_path, free_tcp_port):
+    """Two real processes: each records host-local metrics, host 0
+    gathers over the coordination service and writes cluster totals
+    (reuses the multihost_worker.py launch harness)."""
+    import subprocess
+    import sys as _sys
+    from pathlib import Path
+
+    worker = Path(__file__).with_name("multihost_metrics_worker.py")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        p
+        for p in (str(worker.parent.parent), env.get("PYTHONPATH"))
+        if p
+    )
+    procs = [
+        subprocess.Popen(
+            [_sys.executable, str(worker), str(pid), "2",
+             str(free_tcp_port), str(tmp_path)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in range(2)
+    ]
+    logs = []
+    for p in procs:
+        try:
+            stdout, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        logs.append(stdout)
+    if any(p.returncode == 42 for p in procs):
+        pytest.skip(
+            "rig cannot join a 2-process jax.distributed runtime:\n"
+            + "\n".join(logs)
+        )
+    assert all(p.returncode == 0 for p in procs), "\n".join(logs)
+    with open(os.path.join(str(tmp_path), "metrics_cluster.json")) as f:
+        merged = json.load(f)
+    assert merged["hosts"] == 2
+    m = merged["metrics"]
+    assert m["mh_rows"] == 300  # 100 (host 0) + 200 (host 1)
+    assert m["mh_calls{host=0}"] == 1 and m["mh_calls{host=1}"] == 2
+    assert m["mh_hbm_peak"] == 2000.0  # max across hosts
+    t = m["mh_step_seconds"]
+    assert t["count"] == 20 and "p95_s" in t
