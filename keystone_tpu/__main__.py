@@ -56,6 +56,7 @@ COMMANDS = {
     "observe": "keystone_tpu.observe.report",
     "faults": "keystone_tpu.resilience.faults",
     "plan": "keystone_tpu.plan.cli",
+    "supervise": "keystone_tpu.resilience.supervisor",
 }
 
 
@@ -99,19 +100,30 @@ def main(argv: list[str] | None = None) -> None:
             f" event log there, rendered by `observe <dir>` and tailed live"
             f" by\n `observe top <dir>`; `faults --list`\n"
             f" prints the KEYSTONE_FAULTS injection sites; `plan <model>`\n"
-            f" prints the cost-based planner's chosen plan without executing)"
+            f" prints the cost-based planner's chosen plan without executing;\n"
+            f" `supervise -- CMD` relaunches a multihost job on host loss —\n"
+            f" see `supervise --help`)"
         )
     if argv[0] in COMMANDS:
         import importlib
 
         return importlib.import_module(COMMANDS[argv[0]]).main(argv[1:])
-    from keystone_tpu.core.runtime import enable_compilation_cache
+    if not multihost:
+        # multihost workers get the cache inside mh.initialize() — one
+        # configuration per process, not two
+        from keystone_tpu.core.runtime import enable_compilation_cache
 
-    enable_compilation_cache()
+        enable_compilation_cache()
     if multihost:
         from keystone_tpu.parallel import multihost as mh
+        from keystone_tpu.resilience import cluster as _cluster
 
         mh.initialize()
+        # membership heartbeats + failure detection for the whole run:
+        # a lost host becomes a clean EXIT_HOST_LOST exit (below) that
+        # `python -m keystone_tpu supervise` relaunches, instead of a
+        # silent collective hang
+        _cluster.start_monitor()
     name, rest = argv[0], argv[1:]
     target = None
     if name in PIPELINES:
@@ -142,8 +154,14 @@ def main(argv: list[str] | None = None) -> None:
     def rollup():
         # multihost metrics roll-up: every host calls it (collective
         # barrier); host 0 merges cluster totals into the run dir so the
-        # report isn't host-0-only. Never fatal.
+        # report isn't host-0-only. Never fatal. Skipped after a host
+        # loss — the roll-up barrier would only time out against the
+        # dead peer.
         if not multihost:
+            return
+        from keystone_tpu.resilience import cluster as _cl
+
+        if _cl.check_lost() is not None:
             return
         try:
             from keystone_tpu.observe import events as _events
@@ -159,17 +177,33 @@ def main(argv: list[str] | None = None) -> None:
                 file=_sys.stderr,
             )
 
-    if observe_dir is not None:
-        # scoped run: the launcher brackets the whole pipeline with
-        # run_start/run_end so the report knows total wall and status
-        from keystone_tpu.observe import events
+    try:
+        if observe_dir is not None:
+            # scoped run: the launcher brackets the whole pipeline with
+            # run_start/run_end so the report knows total wall and status
+            from keystone_tpu.observe import events
 
-        with events.run(observe_dir, pipeline=name, argv=rest):
+            with events.run(observe_dir, pipeline=name, argv=rest):
+                dispatch()
+                rollup()
+        else:
             dispatch()
             rollup()
-    else:
-        dispatch()
-        rollup()
+    except Exception as e:
+        if multihost:
+            from keystone_tpu.resilience import cluster as _cl
+
+            if isinstance(e, _cl.ClusterError):
+                # the supervisor's exit-code protocol: host loss is a
+                # re-mesh request, not a crash
+                print(f"# host loss: {e}", file=sys.stderr)
+                raise SystemExit(_cl.EXIT_HOST_LOST) from e
+        raise
+    finally:
+        if multihost:
+            from keystone_tpu.resilience import cluster as _cl
+
+            _cl.stop_monitor()
 
 
 if __name__ == "__main__":

@@ -33,6 +33,119 @@ from keystone_tpu.core.logging import get_logger
 
 logger = get_logger(__name__)
 
+#: kill switch for per-leaf content digests (``0``/``off`` disables
+#: both write and verify — e.g. when leaves are too large to hash or
+#: not fully addressable on this host)
+ENV_CKPT_DIGEST = "KEYSTONE_CKPT_DIGEST"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A restored checkpoint's content digests don't match what was
+    saved — a torn write or on-disk corruption. Restore falls back to
+    the next older step instead of resuming from garbage."""
+
+
+class CheckpointMismatchError(ValueError):
+    """The checkpoint's structure belongs to a DIFFERENT run (leaf
+    count mismatch). Distinct from corruption: falling back to an older
+    step can't fix pointing at the wrong directory, so restore fails
+    loudly. Subclasses ValueError for compatibility with callers that
+    catch the old type."""
+
+
+def _digests_enabled() -> bool:
+    import os
+
+    return os.environ.get(ENV_CKPT_DIGEST, "").lower() not in ("0", "off")
+
+
+def leaf_digest(leaf) -> str:
+    """Content digest of one checkpoint leaf (host-fetched, contiguous
+    bytes) — the unit of the torn-checkpoint detector."""
+    arr = np.asarray(jax.device_get(leaf))
+    return hashlib.sha256(np.ascontiguousarray(arr).tobytes()).hexdigest()
+
+
+def _digest_path(mgr, step: int) -> pathlib.Path:
+    return pathlib.Path(str(mgr.directory)) / f"digests_{int(step)}.json"
+
+
+def _write_digests(mgr, step: int, state, steps_on_disk=None) -> None:
+    """Record per-leaf content digests beside the step (process 0,
+    atomic tmp+replace), and prune digest files whose steps the manager
+    has already garbage-collected. Best-effort: a failed digest write
+    degrades restore verification to the legacy no-digest path, it must
+    never fail the save that is the run's survival point.
+
+    ``steps_on_disk`` is the caller's pre-save ``all_steps()`` listing,
+    reused so each save pays one directory round-trip, not two; it
+    over-approximates the keep set (a step this save just GC'd lingers
+    one cycle before its digest file is pruned), which is fine for a
+    best-effort prune."""
+    if not _digests_enabled():
+        return
+    try:
+        if jax.process_index() != 0:
+            return
+        digests = [
+            leaf_digest(x) for x in jax.tree_util.tree_leaves(state)
+        ]
+        path = _digest_path(mgr, step)
+        tmp = path.with_suffix(".json.tmp")
+        tmp.write_text(json.dumps({"step": int(step), "leaves": digests}))
+        tmp.replace(path)
+        if steps_on_disk is None:
+            steps_on_disk = {int(s) for s in mgr.all_steps()}
+        keep = steps_on_disk | {int(step)}
+        for stale in path.parent.glob("digests_*.json"):
+            try:
+                if int(stale.stem.split("_", 1)[1]) not in keep:
+                    stale.unlink()
+            except (ValueError, OSError):
+                continue
+    except Exception as e:  # noqa: BLE001 — best-effort integrity aid
+        logger.warning(
+            "checkpoint digest write for step %s failed (%r); restore "
+            "verification degrades to legacy (no-digest) for this step",
+            step,
+            e,
+        )
+
+
+def _verify_digests(mgr, step: int, leaves, checkpoint_dir) -> None:
+    """Compare restored leaves against the digests recorded at save
+    time; raises :class:`CheckpointCorruptError` on any mismatch. A
+    missing digest file (legacy checkpoint, or digests disabled) skips
+    verification."""
+    if not _digests_enabled():
+        return
+    path = _digest_path(mgr, step)
+    if not path.exists():
+        return
+    try:
+        want = json.loads(path.read_text()).get("leaves") or []
+    except (OSError, ValueError) as e:
+        raise CheckpointCorruptError(
+            f"{checkpoint_dir} step {step}: unreadable digest sidecar "
+            f"({e!r}) — treating the step as torn"
+        ) from e
+    if len(want) != len(leaves):
+        raise CheckpointCorruptError(
+            f"{checkpoint_dir} step {step}: {len(leaves)} restored "
+            f"leaves vs {len(want)} recorded digests — torn checkpoint"
+        )
+    bad = [
+        i
+        for i, (leaf, digest) in enumerate(zip(leaves, want))
+        if leaf_digest(leaf) != digest
+    ]
+    if bad:
+        raise CheckpointCorruptError(
+            f"{checkpoint_dir} step {step}: content digest mismatch on "
+            f"leaf index(es) {bad[:8]}{'...' if len(bad) > 8 else ''} — "
+            "torn or corrupt checkpoint"
+        )
+
 
 def _fit_meta(est, data, labels, n_valid) -> dict:
     """Identity payload for a fit: estimator hyperparams (num_iter
@@ -94,10 +207,15 @@ def _check_meta(
 
     ``legacy_defaults`` fills keys absent from an older sidecar with the
     value the code used before the key existed — adding a new meta field
-    must not brick every checkpoint written before it."""
+    must not brick every checkpoint written before it. The ``cluster``
+    key is informational (mesh shape / process count at save time) and
+    excluded from the identity comparison: restoring on a DIFFERENT
+    host set is exactly what elastic re-mesh recovery does."""
     if not meta_path.exists():
         return
     saved = json.loads(meta_path.read_text())
+    saved.pop("cluster", None)
+    meta = {k: v for k, v in meta.items() if k != "cluster"}
     if legacy_defaults:
         saved = {**{k: v for k, v in legacy_defaults.items()}, **saved}
     if saved != meta:
@@ -137,12 +255,69 @@ def _restore_leaves(mgr, step, template, checkpoint_dir, what: str):
         "leaves"
     ]
     if len(restored) != len(leaves):
-        raise ValueError(
+        raise CheckpointMismatchError(
             f"{checkpoint_dir} checkpoint has {len(restored)} leaves; "
             f"this {what}'s state has {len(leaves)} — the directory "
             "belongs to a different run"
         )
+    _verify_digests(mgr, step, restored, checkpoint_dir)
     return jax.tree_util.tree_unflatten(treedef, restored)
+
+
+def _restore_latest_intact(mgr, template, checkpoint_dir, what: str):
+    """``(state, step)`` for the NEWEST intact checkpoint: steps are
+    tried newest-first, and a torn/corrupt one (digest mismatch, orbax
+    read failure, exhausted IO retries) falls back to the next older
+    step with a ``ckpt_fallback`` resilience event instead of crashing
+    the resume. A structural mismatch (different run) still fails loudly
+    — falling back can't fix pointing at the wrong directory. Returns
+    ``(None, 0)`` when the directory holds no steps at all."""
+    steps = sorted((int(s) for s in mgr.all_steps()), reverse=True)
+    last_err: Exception | None = None
+    for step in steps:
+        try:
+            state = _restore_leaves(
+                mgr, step, template, checkpoint_dir, what
+            )
+            if last_err is not None:
+                logger.warning(
+                    "resumed %s from step %d after newer step(s) failed "
+                    "to restore (%r)",
+                    what,
+                    step,
+                    last_err,
+                )
+            return state, step
+        except CheckpointMismatchError:
+            raise  # different run, not corruption
+        except Exception as e:  # noqa: BLE001 — corruption/IO family
+            # (incl. ValueError/JSONDecodeError from orbax reading a
+            # torn step — only the explicit mismatch type passes through)
+            last_err = e
+            from keystone_tpu.resilience.emit import decision
+
+            decision(
+                "ckpt_fallback",
+                counter="ckpt_fallbacks",
+                step=step,
+                error=repr(e),
+            )
+            logger.warning(
+                "checkpoint step %d of %s is torn or unreadable (%r); "
+                "falling back to the previous step",
+                step,
+                checkpoint_dir,
+                e,
+            )
+            # deliberately NOT deleted here: restore-time failures can
+            # be transient (memory pressure, a filesystem outage past
+            # the retry budget) and deleting on them could cascade
+            # through every intact step. The torn step is replaced at
+            # save time instead (_save_leaves), when the replayed
+            # interval holds a known-good state for it.
+    if last_err is not None:
+        raise last_err
+    return None, 0
 
 
 def _save_leaves(mgr, step: int, state) -> None:
@@ -156,6 +331,32 @@ def _save_leaves(mgr, step: int, state) -> None:
     from keystone_tpu.resilience import faults
     from keystone_tpu.resilience.retry import CHECKPOINT_POLICY
 
+    # re-saving a step that already exists on disk is only reachable
+    # when restore skipped it as torn and the interval was replayed —
+    # orbax refuses to overwrite an existing step, which would silently
+    # drop the repair. Delete it now, when the in-memory state IS the
+    # good replacement (never at restore time, where a transient read
+    # failure could cascade-delete intact steps).
+    try:
+        steps_on_disk = {int(s) for s in mgr.all_steps()}
+    except Exception:  # noqa: BLE001 — listing failure: let save decide
+        steps_on_disk = None
+    if steps_on_disk and int(step) in steps_on_disk:
+        try:
+            mgr.delete(int(step))
+            logger.warning(
+                "replacing checkpoint step %d (previously torn or "
+                "skipped on restore)",
+                step,
+            )
+        except Exception as e:  # noqa: BLE001 — best-effort repair
+            logger.warning(
+                "could not delete existing checkpoint step %d (%r); "
+                "this save may be dropped",
+                step,
+                e,
+            )
+
     def _attempt():
         faults.maybe_raise("ckpt.save", note=f"step {step}")
         mgr.save(
@@ -167,6 +368,7 @@ def _save_leaves(mgr, step: int, state) -> None:
         mgr.wait_until_finished()
 
     CHECKPOINT_POLICY.call(_attempt, label="ckpt.save")
+    _write_digests(mgr, step, state, steps_on_disk=steps_on_disk)
 
 
 def _write_meta_atomic(meta_path, meta) -> None:
@@ -271,15 +473,19 @@ def _resumable_fit_inner(
                 data,
                 labels,
             )
-            model = _restore_leaves(
-                mgr, done, template, checkpoint_dir, "fit"
+            # newest INTACT step: a torn/corrupt newest checkpoint
+            # falls back to the previous one (redoing at most `every`
+            # passes) instead of crashing the resume
+            model, done = _restore_latest_intact(
+                mgr, template, checkpoint_dir, "fit"
             )
-            logger.info(
-                "resuming fit from %s: %d/%d passes done",
-                checkpoint_dir,
-                done,
-                total,
-            )
+            if model is not None:
+                logger.info(
+                    "resuming fit from %s: %d/%d passes done",
+                    checkpoint_dir,
+                    done,
+                    total,
+                )
     if latest is None or not meta_path.exists():
         # overwrite unconditionally when no checkpoint exists yet: a
         # crashed first-chunk run may have left a stale meta that would
@@ -320,23 +526,43 @@ class TrainCheckpointer:
     Restore is exact when the loop derives step ``i``'s batch from
     ``(seed, i)`` rather than sequential RNG draws — the resumed run then
     replays the identical trajectory (tested for the LM trainer).
+
+    Multihost mode is automatic: when ``jax.process_count() > 1`` every
+    save is *coordinated* — all hosts agree on the step at a
+    coordination-service barrier before any host writes
+    (:func:`keystone_tpu.resilience.cluster.checkpoint_barrier`, bounded
+    by ``KEYSTONE_CKPT_BARRIER_S``), so a dead or wedged peer produces a
+    loud :class:`~keystone_tpu.resilience.cluster.ClusterBarrierError`
+    instead of a torn checkpoint. ``cluster_info`` (process count, mesh
+    shape) is recorded in the sidecar but EXCLUDED from the identity
+    check: any subset of the original host set may restore — that is
+    the elastic re-mesh recovery path.
     """
 
     def __init__(self, checkpoint_dir: str, meta: dict,
-                 legacy_defaults: dict | None = None):
+                 legacy_defaults: dict | None = None,
+                 cluster_info: dict | None = None):
         self._dir = checkpoint_dir
         self._meta = json.loads(json.dumps(meta, default=str))
         self._legacy = legacy_defaults or {}
+        self._cluster_info = (
+            json.loads(json.dumps(cluster_info, default=str))
+            if cluster_info
+            else None
+        )
         self._meta_path = (
             pathlib.Path(checkpoint_dir).absolute() / "train_meta.json"
         )
         self._mgr = _manager(checkpoint_dir)
 
     def restore(self, template):
-        """(state, start_step): the latest checkpoint restored into
-        ``template``'s pytree structure, or ``(template, 0)`` when the
-        directory is fresh. Raises on a meta mismatch (different run) or
-        a leaf-structure mismatch."""
+        """(state, start_step): the newest INTACT checkpoint restored
+        into ``template``'s pytree structure, or ``(template, 0)`` when
+        the directory is fresh. A torn/corrupt newest step (content
+        digest mismatch, unreadable orbax step) falls back to the
+        previous one with a ``ckpt_fallback`` resilience event. Raises
+        on a meta mismatch (different run) or a leaf-structure
+        mismatch."""
         latest = self._mgr.latest_step()
         if latest is None or int(latest) == 0:
             self._write_meta()
@@ -345,23 +571,36 @@ class TrainCheckpointer:
             self._dir, self._meta_path, self._meta, "training run",
             legacy_defaults=self._legacy,
         )
-        state = _restore_leaves(
-            self._mgr, latest, template, self._dir, "training run"
+        state, step = _restore_latest_intact(
+            self._mgr, template, self._dir, "training run"
         )
-        if not self._meta_path.exists():
-            # checkpoints without a sidecar: a deleted/crashed meta would
-            # poison later identity checks — rewrite the current one
+        if state is None:
             self._write_meta()
+            return template, 0
+        # refresh the sidecar after a successful identity check: a
+        # deleted/crashed meta must not poison later checks, and the
+        # informational cluster block must reflect THIS host set (a
+        # re-meshed resume runs on fewer processes than the save did)
+        self._write_meta()
         logger.info(
-            "resuming training from %s: step %d", self._dir, int(latest)
+            "resuming training from %s: step %d", self._dir, step
         )
-        return state, int(latest)
+        return state, step
 
     def save(self, state, step: int) -> None:
+        from keystone_tpu.resilience.cluster import checkpoint_barrier
+
+        # multihost: agree on the step before anyone writes; the
+        # barrier sits OUTSIDE the retry policy (a barrier id must not
+        # be re-waited within one runtime incarnation)
+        checkpoint_barrier(step)
         _save_leaves(self._mgr, step, state)
 
     def close(self) -> None:
         self._mgr.close()
 
     def _write_meta(self) -> None:
-        _write_meta_atomic(self._meta_path, self._meta)
+        meta = dict(self._meta)
+        if self._cluster_info:
+            meta["cluster"] = self._cluster_info
+        _write_meta_atomic(self._meta_path, meta)

@@ -44,11 +44,21 @@ def pin_platform(platform: str | None = None) -> str | None:
 def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
     """Point JAX's persistent compilation cache at a durable directory.
 
-    Priority: explicit arg → ``KEYSTONE_XLA_CACHE`` env (empty string
-    disables) → ``~/.cache/keystone_tpu/xla``. Returns the directory in
+    Priority: explicit arg → ``KEYSTONE_COMPILE_CACHE_DIR`` env →
+    ``KEYSTONE_XLA_CACHE`` (legacy alias) → ``~/.cache/keystone_tpu/
+    xla``; an empty-string env value disables. Returns the directory in
     use, or None when disabled. Safe to call multiple times; must run
     before the first jit compilation to help that compilation.
+
+    Point it at a path shared across the host set (NFS/GCS-fuse) and a
+    relaunched or rejoining host warm-starts from already-compiled
+    executables in seconds instead of recompiling for minutes — the
+    elastic-multihost rejoin cost is a compilation-cache problem, so
+    :func:`keystone_tpu.parallel.multihost.initialize` enables this on
+    every multihost worker start.
     """
+    if cache_dir is None:
+        cache_dir = os.environ.get("KEYSTONE_COMPILE_CACHE_DIR")
     if cache_dir is None:
         cache_dir = os.environ.get("KEYSTONE_XLA_CACHE", _DEFAULT_CACHE_DIR)
     if not cache_dir:

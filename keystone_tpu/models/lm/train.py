@@ -305,10 +305,20 @@ def train(
       masking the original error) and the run falls back to the last
       periodic checkpoint.
     - ``step_timeout_s`` (or ``KEYSTONE_STEP_TIMEOUT_S``) arms a
-      watchdog that logs thread stacks when a step stops completing.
+      watchdog that logs thread stacks when a step stops completing;
+      ``KEYSTONE_STEP_ESCALATE=N`` additionally hard-aborts the process
+      after N consecutive stalls so a supervisor can replace it.
+    - on a multihost run with an active cluster monitor
+      (:mod:`keystone_tpu.resilience.cluster`), every completed step is
+      reported to the heartbeat thread, checkpoint saves are
+      coordinated behind a membership barrier, and a declared host loss
+      exits the loop with :class:`HostLostError` on the last periodic
+      checkpoint (the coordinated rescue save is impossible with a dead
+      peer) — the run supervisor relaunches on the survivor set.
     - fault sites ``train.nan`` / ``train.preempt`` / ``train.sigterm``
-      (``KEYSTONE_FAULTS``, keyed by step index so schedules survive
-      resume) inject each failure deterministically.
+      / ``cluster.host_kill`` (``KEYSTONE_FAULTS``, keyed by step index
+      so schedules survive resume) inject each failure
+      deterministically.
     """
     import hashlib
     import os as _os
@@ -322,6 +332,7 @@ def train(
     from keystone_tpu.observe import telemetry as _telemetry
     from keystone_tpu.observe import tracing as _tracing
     from keystone_tpu.parallel.mesh import data_sharding
+    from keystone_tpu.resilience import cluster as _cluster
     from keystone_tpu.resilience import faults as _faults
     from keystone_tpu.resilience.guards import (
         LossGuard,
@@ -370,6 +381,10 @@ def train(
 
     ckpt = None
     start = 0
+    try:
+        _nprocs = jax.process_count()
+    except Exception:  # noqa: BLE001 — backend init failure
+        _nprocs = 1
     if checkpoint_dir:
         from keystone_tpu.core.checkpoint import TrainCheckpointer
 
@@ -431,6 +446,17 @@ def train(
                     for leaf in jax.tree_util.tree_leaves(model)
                 ],
             },
+            # informational, EXCLUDED from the identity check: the
+            # host set at save time, so the supervisor / a re-meshed
+            # resume can see what the checkpoint was written by
+            cluster_info={
+                "num_processes": _nprocs,
+                "mesh": (
+                    {k: int(v) for k, v in mesh.shape.items()}
+                    if mesh is not None
+                    else None
+                ),
+            },
             # keys added after checkpoints already existed in the wild:
             # an older sidecar without them must compare as the value the
             # code used at the time, not brick the resume
@@ -482,8 +508,18 @@ def train(
 
         # created here, STARTED after the first step completes: the
         # first iteration includes jit compilation, which would
-        # otherwise guarantee a spurious stall report on every run
-        dog = Watchdog(step_timeout_s, label="lm_train")
+        # otherwise guarantee a spurious stall report on every run.
+        # KEYSTONE_STEP_ESCALATE=N hard-aborts after N consecutive
+        # stalls — a wedged main thread would otherwise heartbeat
+        # forever from the cluster monitor's daemon thread
+        escalate = int(
+            _os.environ.get("KEYSTONE_STEP_ESCALATE", "0") or 0
+        )
+        dog = Watchdog(
+            step_timeout_s,
+            label="lm_train",
+            escalate_after=escalate if escalate > 0 else None,
+        )
 
     # live telemetry (observe/telemetry.py): per-step loss / tokens-per-s
     # / MFU into steps.jsonl whenever an observe sink is active, HBM
@@ -502,6 +538,7 @@ def train(
 
     completed = last_saved = 0
     halted = False
+    cluster_lost = False
     try:
         if ckpt is not None:
             (model, opt_state), start = ckpt.restore((model, opt_state))
@@ -533,6 +570,7 @@ def train(
             # the recorded per-step wall honest under async dispatch)
             losses.append(loss)
             completed = i + 1
+            _cluster.note_step(completed)
             steplog = _telemetry.active_step_log()
             if steplog is not None:
                 steplog.step(
@@ -549,6 +587,23 @@ def train(
                 dog.pet() if dog.running else dog.start()
             if log_every and (i + 1) % log_every == 0:
                 logger.info("step %d loss %.4f", i + 1, float(loss))
+            if _faults.fire("cluster.host_kill", key=i):
+                # a dying machine checkpoints nothing, flushes nothing,
+                # cleans up nothing — SIGKILL models exactly that; the
+                # survivors' failure detector and the run supervisor
+                # take it from here (fires BEFORE the periodic save so
+                # the drill actually loses in-interval steps)
+                logger.warning(
+                    "cluster.host_kill fault at step %d: killing this "
+                    "process", i
+                )
+                _os.kill(_os.getpid(), _signal.SIGKILL)
+            lost = _cluster.check_lost()
+            if lost is not None:
+                # exit BEFORE the periodic save: a coordinated save
+                # with a known-dead peer can only time out at the
+                # barrier
+                raise _cluster.HostLostError(lost)
             if ckpt is not None and (
                 (i + 1) % every == 0 or (i + 1) == steps
             ):
@@ -580,6 +635,18 @@ def train(
                 break
             _faults.maybe_preempt(key=i)
         loss_guard.flush()
+    except _cluster.ClusterError as e:
+        # a lost peer makes the coordinated rescue save impossible (its
+        # barrier would wait on the dead host) — exit cleanly on the
+        # last periodic checkpoint, at most one checkpoint interval
+        # behind; the supervisor re-meshes and resumes from there
+        cluster_lost = True
+        logger.warning(
+            "training stopped by cluster membership change at step %d: "
+            "%s", completed, e,
+        )
+        _emit_resilience("host_lost_exit", step=completed, error=repr(e))
+        raise
     except NumericalHealthError as e:
         # halt-with-last-good-checkpoint: training is unhealthy; return
         # the last checkpointed state rather than the post-spike one
@@ -597,7 +664,12 @@ def train(
         losses = losses[: max(restored - start, 0)]
     finally:
         try:
-            if ckpt is not None and completed > last_saved and not halted:
+            if (
+                ckpt is not None
+                and completed > last_saved
+                and not halted
+                and not cluster_lost
+            ):
                 # preemption / signal / crash path: the loop's periodic
                 # save didn't cover the last completed step — write it
                 # now so at most the in-flight step is lost

@@ -45,6 +45,7 @@ def summarize(events: list[dict]) -> dict[str, Any]:
     phases: list[dict] = []
     spans: list[dict] = []
     optimizes: list[dict] = []
+    clusters: list[dict] = []
     device_memory: dict | None = None
     trace_windows: list[dict] = []
     meta: dict[str, Any] = {"run": None, "wall_s": None, "status": None}
@@ -73,6 +74,8 @@ def summarize(events: list[dict]) -> dict[str, Any]:
             spans.append(ev)
         elif kind == "optimize":
             optimizes.append(ev)
+        elif kind == "cluster":
+            clusters.append(ev)
         elif kind == "device_memory":
             device_memory = ev  # latest sample carries current watermarks
         elif kind == "trace_window":
@@ -87,6 +90,7 @@ def summarize(events: list[dict]) -> dict[str, Any]:
         "phases": phases,
         "spans": spans,
         "optimizes": optimizes,
+        "clusters": clusters,
         "device_memory": device_memory,
         "trace_windows": trace_windows,
     }
@@ -209,6 +213,16 @@ def render(run_dir: str) -> str:
                     if k not in ("event", "source", "ts", "run", "seq")
                 )
                 lines.append(f"  [{src}] {fields}")
+        lines.append("")
+    if summary.get("clusters"):
+        lines.append("cluster membership (heartbeats / supervisor):")
+        for ev in summary["clusters"]:
+            fields = ", ".join(
+                f"{k}={v}"
+                for k, v in ev.items()
+                if k not in ("event", "ts", "run", "phase", "action")
+            )
+            lines.append(f"  {ev.get('action', '?')}: {fields}")
         lines.append("")
     lines.extend(_telemetry_sections(run_dir, summary))
     if peak is None and profiles:

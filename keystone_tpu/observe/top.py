@@ -120,6 +120,7 @@ def summarize(steps: list[dict], events: list[dict]) -> dict[str, Any]:
         "devices": [],
         "hbm_peak_bytes": None,
         "resilience": {},
+        "cluster": {},
         "plan_decisions": 0,
         "plan_streams": 0,
         "trace_windows": 0,
@@ -175,6 +176,9 @@ def summarize(steps: list[dict], events: list[dict]) -> dict[str, Any]:
         elif kind == "resilience":
             action = str(ev.get("action", "?"))
             out["resilience"][action] = out["resilience"].get(action, 0) + 1
+        elif kind == "cluster":
+            action = str(ev.get("action", "?"))
+            out["cluster"][action] = out["cluster"].get(action, 0) + 1
         elif kind == "optimize":
             out["plan_decisions"] += len(ev.get("decisions") or []) or 1
         elif kind == "trace_window":
@@ -244,6 +248,11 @@ def render(state: dict[str, Any], run_dir: str) -> str:
             f"{k}={v}" for k, v in sorted(state["resilience"].items())
         )
         lines.append(f"resilience: {pairs}")
+    if state.get("cluster"):
+        pairs = "  ".join(
+            f"{k}={v}" for k, v in sorted(state["cluster"].items())
+        )
+        lines.append(f"cluster: {pairs}")
     if state["plan_decisions"] or state.get("plan_streams"):
         parts = []
         if state["plan_decisions"]:

@@ -52,7 +52,12 @@ def initialize(
 
     With TPU VMs all arguments are discovered from the environment
     (``jax.distributed.initialize()`` no-arg form); explicit values support
-    CPU/GPU test rigs.
+    CPU/GPU test rigs. Under the run supervisor (``python -m keystone_tpu
+    supervise``) the per-generation wiring arrives as
+    ``KEYSTONE_COORDINATOR`` / ``KEYSTONE_PROCESS_ID`` /
+    ``KEYSTONE_NUM_PROCESSES`` — consumed here as defaults, so
+    ``supervise -- python -m keystone_tpu --multihost ...`` needs no
+    placeholder plumbing; explicit arguments still win.
 
     ``init_timeout_s`` (default ``KEYSTONE_INIT_TIMEOUT_S``, else 300)
     bounds the join: a missing peer or dead coordinator fails in
@@ -69,6 +74,33 @@ def initialize(
         init_timeout_s = float(
             os.environ.get(ENV_INIT_TIMEOUT, "") or _DEFAULT_INIT_TIMEOUT_S
         )
+    if coordinator_address is None and os.environ.get("KEYSTONE_COORDINATOR"):
+        # the run supervisor's per-generation wiring (recomputed on
+        # every relaunch — a stale value can't leak across generations
+        # because the supervisor rewrites all three per child)
+        coordinator_address = os.environ["KEYSTONE_COORDINATOR"]
+        missing = [
+            name
+            for arg, name in (
+                (num_processes, "KEYSTONE_NUM_PROCESSES"),
+                (process_id, "KEYSTONE_PROCESS_ID"),
+            )
+            if arg is None and name not in os.environ
+        ]
+        if missing:
+            raise RuntimeError(
+                "KEYSTONE_COORDINATOR is set "
+                f"({coordinator_address!r}) but {' and '.join(missing)} "
+                "is not — the three variables wire one cluster together "
+                "and must be set as a group (the run supervisor exports "
+                "all of them; a manual launch must too). Unset "
+                "KEYSTONE_COORDINATOR to use jax's own environment "
+                "discovery instead."
+            )
+        if num_processes is None:
+            num_processes = int(os.environ["KEYSTONE_NUM_PROCESSES"])
+        if process_id is None:
+            process_id = int(os.environ["KEYSTONE_PROCESS_ID"])
     kwargs = {"initialization_timeout": max(int(init_timeout_s), 1)}
     if coordinator_address is not None:
         kwargs.update(
@@ -102,6 +134,15 @@ def initialize(
         jax.local_device_count(),
         jax.device_count(),
     )
+    # every multihost worker start warm-starts from the persistent XLA
+    # cache (KEYSTONE_COMPILE_CACHE_DIR): a relaunched/rejoining host's
+    # cold-start cost is compilation, and the supervisor's whole loss
+    # budget assumes rejoin takes seconds, not minutes
+    from keystone_tpu.core.runtime import enable_compilation_cache
+
+    cache = enable_compilation_cache()
+    if cache:
+        logger.info("multihost: persistent compilation cache at %s", cache)
 
 
 def _preflight_coordinator(

@@ -17,7 +17,11 @@ of ingest-level skip/retry as a framework concern:
   (donation-safe in-program skip, one host sync per interval) and the
   opt-in pipeline output guard (``KEYSTONE_GUARD_OUTPUTS``).
 - :mod:`.watchdog` — step-time stall detection with thread-stack
-  diagnostics.
+  diagnostics, optionally escalating a wedged loop to a hard abort.
+- :mod:`.cluster` — elastic-multihost membership: coordination-service
+  heartbeats, host-loss detection, coordinated-checkpoint barriers, and
+  the exit-code protocol :mod:`.supervisor` (``python -m keystone_tpu
+  supervise``) drives to relaunch a job on the surviving host set.
 
 All four are stdlib-light at import (jax loads lazily inside
 functions) so the loaders and core pipeline can depend on them without
@@ -29,7 +33,21 @@ exactly what was survived.
 
 from __future__ import annotations
 
-from keystone_tpu.resilience import faults, guards, retry, watchdog  # noqa: F401
+from keystone_tpu.resilience import (  # noqa: F401
+    cluster,
+    faults,
+    guards,
+    retry,
+    watchdog,
+)
+from keystone_tpu.resilience.cluster import (  # noqa: F401
+    EXIT_HOST_LOST,
+    EXIT_WEDGED,
+    ClusterBarrierError,
+    ClusterError,
+    ClusterMonitor,
+    HostLostError,
+)
 from keystone_tpu.resilience.faults import (  # noqa: F401
     AcceleratorDrop,
     InjectedFault,

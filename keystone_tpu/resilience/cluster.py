@@ -1,0 +1,582 @@
+"""Elastic multihost membership: heartbeats, host-loss detection, and
+the coordinated-exit protocol the run supervisor drives.
+
+The reference inherited cluster-scope fault tolerance from Spark — a
+lost executor was recomputed from lineage. The jax_graft rebuild has no
+lineage; a lost host turns every collective into a silent hang until the
+TPU-hours burn out. This module turns membership into an explicit,
+observable protocol over the same coordination-service KV channel the
+metrics roll-up already uses (:mod:`keystone_tpu.parallel.multihost`):
+
+- every host publishes ``keystone/cluster/heartbeat/<pid>`` on a
+  ``KEYSTONE_HEARTBEAT_S`` cadence from a daemon thread (payload: a beat
+  counter plus the last step :func:`note_step` recorded);
+- host 0 runs the failure detector: a host whose payload stops changing
+  for ``KEYSTONE_HEARTBEAT_TIMEOUT_S`` (measured on host 0's OWN
+  monotonic clock — cross-host wall clocks are never compared) is
+  declared dead, and the verdict is published under the poison key
+  ``keystone/cluster/lost`` so every survivor sees it on its next beat;
+- survivors exit the train loop cleanly (:class:`HostLostError`,
+  translated to :data:`EXIT_HOST_LOST` at the process boundary) and the
+  run supervisor (``python -m keystone_tpu supervise``) relaunches the
+  job on the surviving host set, restoring from the last coordinated
+  checkpoint — at most one checkpoint interval of steps lost;
+- a survivor wedged inside a dead collective can't reach its loop check,
+  so after ``KEYSTONE_HOSTLOSS_ABORT_S`` of being flagged the monitor
+  hard-aborts the process (``os._exit``) — under a supervisor the abort
+  IS the clean path, because the last coordinated checkpoint already
+  exists and a relaunch is cheaper than a hang.
+
+Deterministic drills: the ``cluster.heartbeat_drop`` fault site skips a
+publish at the keyed beat, and ``cluster.host_kill`` SIGKILLs the
+process after the keyed train step (no checkpoint, no cleanup — exactly
+what a dying machine does), both via the ``KEYSTONE_FAULTS`` grammar.
+
+Import cost follows the package rule: stdlib-only at module import
+(jax and the coordination client load lazily), and the train-loop hooks
+(:func:`note_step`, :func:`check_lost`) are one module-global read when
+no monitor is active.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable
+
+ENV_HEARTBEAT_S = "KEYSTONE_HEARTBEAT_S"
+ENV_HEARTBEAT_TIMEOUT_S = "KEYSTONE_HEARTBEAT_TIMEOUT_S"
+ENV_HOSTLOSS_ABORT_S = "KEYSTONE_HOSTLOSS_ABORT_S"
+ENV_CKPT_BARRIER_S = "KEYSTONE_CKPT_BARRIER_S"
+
+_DEFAULT_HEARTBEAT_S = 5.0
+_DEFAULT_TIMEOUT_S = 30.0
+_DEFAULT_ABORT_S = 20.0
+_DEFAULT_CKPT_BARRIER_S = 120.0
+
+#: Exit-code protocol between a supervised job and its supervisor. A
+#: survivor that detected a peer loss exits EXIT_HOST_LOST ("re-mesh
+#: me"); a watchdog-escalated wedge exits EXIT_WEDGED ("restart me in
+#: place"). Both are restartable; any other nonzero exit is a real
+#: failure the supervisor must NOT loop on.
+EXIT_HOST_LOST = 113
+EXIT_WEDGED = 114
+RESTARTABLE_EXITS = (EXIT_HOST_LOST, EXIT_WEDGED)
+
+HEARTBEAT_PREFIX = "keystone/cluster/heartbeat/"
+LOST_KEY = "keystone/cluster/lost"
+
+
+class ClusterError(RuntimeError):
+    """Base of the membership-change error family. Deliberately never
+    carries the transient RPC status words (UNAVAILABLE, ...) in its
+    message: a membership change is not healed by retrying the call
+    that noticed it."""
+
+
+class HostLostError(ClusterError):
+    """The failure detector has declared peer host(s) dead. The train
+    loop raises this to exit cleanly; the process boundary translates
+    it to :data:`EXIT_HOST_LOST` for the supervisor."""
+
+    def __init__(self, lost, message: str | None = None):
+        self.lost = tuple(sorted(int(p) for p in lost))
+        super().__init__(
+            message or f"cluster host(s) lost: {list(self.lost)}"
+        )
+
+
+class ClusterBarrierError(ClusterError):
+    """A coordinated-checkpoint barrier timed out — a peer died or
+    wedged mid-interval. The save is abandoned (never half-written) and
+    the run falls back to the last intact checkpoint."""
+
+
+class LocalKV:
+    """In-process KV store with the coordination-service surface the
+    monitor needs — the test transport, and a truthful stand-in for a
+    single-process 'cluster'. ``set`` may be monkeypatched to raise to
+    simulate a dead coordinator."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._data: dict[str, str] = {}
+
+    def set(self, key: str, value: str) -> None:
+        with self._lock:
+            self._data[key] = value
+
+    def get(self, key: str) -> str | None:
+        with self._lock:
+            return self._data.get(key)
+
+    def dir(self, prefix: str) -> dict[str, str] | None:
+        with self._lock:
+            return {
+                k: v for k, v in self._data.items() if k.startswith(prefix)
+            }
+
+
+class CoordKV:
+    """The jax coordination-service KV store, normalized to the
+    three-method surface :class:`ClusterMonitor` uses. ``get`` returns None
+    for absent keys (the client raises on its bounded wait); ``dir``
+    returns None on transport failure so the caller can distinguish
+    "empty" from "coordinator gone"."""
+
+    def __init__(self, client):
+        self._client = client
+
+    def set(self, key: str, value: str) -> None:
+        self._client.key_value_set(key, value)
+
+    def get(self, key: str) -> str | None:
+        try:
+            return self._client.blocking_key_value_get(key, 50)
+        except Exception:  # noqa: BLE001 — absent key or dead transport
+            return None
+
+    def dir(self, prefix: str) -> dict[str, str] | None:
+        try:
+            return dict(self._client.key_value_dir_get(prefix))
+        except Exception:  # noqa: BLE001 — transport failure
+            return None
+
+
+def coordination_kv() -> CoordKV | None:
+    """The live coordination-service KV for this process, or None when
+    ``jax.distributed`` was never initialized."""
+    from keystone_tpu.parallel.multihost import _coordination_client
+
+    client = _coordination_client()
+    return CoordKV(client) if client is not None else None
+
+
+def _env_float(name: str, default: float) -> float:
+    return float(os.environ.get(name, "") or default)
+
+
+class ClusterMonitor:
+    """One process's view of cluster membership.
+
+    Every process publishes heartbeats; host 0 additionally runs the
+    failure detector and publishes the verdict. The monitor thread does
+    all three on the heartbeat cadence; ``clock`` and ``abort`` are
+    injectable so the whole protocol unit-tests with zero sleeping and
+    zero real process kills (``beat_once``/``detect_once``/``tick`` are
+    the thread's body, callable directly).
+    """
+
+    def __init__(
+        self,
+        kv,
+        process_id: int,
+        num_processes: int,
+        *,
+        interval_s: float | None = None,
+        timeout_s: float | None = None,
+        abort_after_s: float | None = None,
+        clock: Callable[[], float] = time.monotonic,
+        abort: Callable[[int], None] = os._exit,
+    ):
+        self.kv = kv
+        self.pid = int(process_id)
+        self.nprocs = int(num_processes)
+        self.interval_s = (
+            interval_s
+            if interval_s is not None
+            else _env_float(ENV_HEARTBEAT_S, _DEFAULT_HEARTBEAT_S)
+        )
+        self.timeout_s = (
+            timeout_s
+            if timeout_s is not None
+            else _env_float(ENV_HEARTBEAT_TIMEOUT_S, _DEFAULT_TIMEOUT_S)
+        )
+        self.abort_after_s = (
+            abort_after_s
+            if abort_after_s is not None
+            else _env_float(ENV_HOSTLOSS_ABORT_S, _DEFAULT_ABORT_S)
+        )
+        if self.interval_s <= 0:
+            raise ValueError(f"interval_s={self.interval_s}: must be > 0")
+        if self.timeout_s <= self.interval_s:
+            raise ValueError(
+                f"timeout_s={self.timeout_s} must exceed the "
+                f"{self.interval_s}s heartbeat interval — a detector "
+                "faster than the publisher declares every host dead"
+            )
+        self.clock = clock
+        self.abort = abort
+        self.beats = 0
+        self.step = 0
+        self._lost: tuple[int, ...] | None = None
+        self._lost_at: float | None = None
+        self._aborted = False
+        # detector state (host 0): pid -> (last payload, local time it
+        # last CHANGED). Local monotonic time only — never a cross-host
+        # wall-clock comparison.
+        self._seen: dict[int, tuple[str | None, float]] = {}
+        self._transport_down_since: float | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -------------------------------------------------- publish side
+
+    def note_step(self, step: int) -> None:
+        """Record training progress for the next heartbeat payload —
+        a plain attribute write, safe on the hot path."""
+        self.step = int(step)
+
+    def beat_once(self, now: float | None = None) -> bool:
+        """Publish one heartbeat (unless the ``cluster.heartbeat_drop``
+        fault eats it). Returns True when the publish reached the KV
+        store. Sustained publish failure on a non-coordinator host is
+        itself a detection signal: the coordinator (host 0) is gone."""
+        from keystone_tpu.observe import metrics
+        from keystone_tpu.resilience import faults
+
+        now = self.clock() if now is None else now
+        beat = self.beats
+        self.beats += 1
+        if faults.fire("cluster.heartbeat_drop", key=beat):
+            return False
+        payload = json.dumps(
+            {"pid": self.pid, "beat": beat, "step": self.step}
+        )
+        try:
+            self.kv.set(HEARTBEAT_PREFIX + str(self.pid), payload)
+        except Exception as e:  # noqa: BLE001 — dead coordinator
+            if self._transport_down_since is None:
+                self._transport_down_since = now
+            if (
+                self.pid != 0
+                and now - self._transport_down_since > self.timeout_s
+            ):
+                self._declare_lost(
+                    (0,), "coordinator_unreachable", now, error=repr(e)
+                )
+            return False
+        self._transport_down_since = None
+        metrics.get_registry().counter("cluster_heartbeats").inc()
+        metrics.get_registry().gauge("cluster_heartbeat_step").set(
+            float(self.step)
+        )
+        return True
+
+    # --------------------------------------------------- detect side
+
+    def detect_once(self, now: float | None = None) -> tuple[int, ...]:
+        """Host 0's failure-detector pass: a peer whose heartbeat
+        payload has not changed (on this host's monotonic clock) for
+        ``timeout_s`` is dead. Publishes the verdict under
+        :data:`LOST_KEY`. Returns the lost set (empty tuple = all
+        alive)."""
+        from keystone_tpu.observe import metrics
+
+        now = self.clock() if now is None else now
+        if self._lost is not None:
+            return self._lost
+        beats = self.kv.dir(HEARTBEAT_PREFIX)
+        if beats is None:
+            # transport failure on the detector itself — count it like
+            # a publish failure; host 0 owns the coordinator, so this
+            # only happens with an injected/external KV
+            if self._transport_down_since is None:
+                self._transport_down_since = now
+            return ()
+        lost: list[int] = []
+        for pid in range(self.nprocs):
+            if pid == self.pid:
+                continue
+            payload = beats.get(HEARTBEAT_PREFIX + str(pid))
+            prev = self._seen.get(pid)
+            if prev is None or prev[0] != payload:
+                # first sight, or fresh beat: (re)start this host's
+                # silence clock. A host that has never published is
+                # measured from monitor start.
+                self._seen[pid] = (payload, now)
+                if payload is not None:
+                    continue
+            last_change = self._seen[pid][1]
+            if now - last_change > self.timeout_s:
+                lost.append(pid)
+        alive = self.nprocs - len(lost)
+        metrics.get_registry().gauge("cluster_alive_hosts").set(
+            float(alive)
+        )
+        if lost:
+            try:
+                self.kv.set(
+                    LOST_KEY,
+                    json.dumps({"lost": lost, "detected_by": self.pid}),
+                )
+            except Exception:  # noqa: BLE001 — verdict still applies
+                # locally even when the poison key can't be published
+                pass
+            self._declare_lost(lost, "heartbeat_timeout", now)
+        return tuple(lost)
+
+    def poll_lost_key(self, now: float | None = None) -> None:
+        """Non-detector hosts: pick up host 0's published verdict."""
+        if self._lost is not None:
+            return
+        raw = self.kv.get(LOST_KEY)
+        if not raw:
+            return
+        try:
+            verdict = json.loads(raw)
+            lost = [int(p) for p in verdict.get("lost", ())]
+        except (ValueError, TypeError):
+            return
+        if lost:
+            self._declare_lost(
+                lost,
+                "peer_verdict",
+                self.clock() if now is None else now,
+                detected_by=verdict.get("detected_by"),
+            )
+
+    def _declare_lost(
+        self,
+        lost,
+        reason: str,
+        now: float,
+        **fields: Any,
+    ) -> None:
+        if self._lost is not None:
+            return
+        self._lost = tuple(sorted(int(p) for p in lost))
+        self._lost_at = now
+        from keystone_tpu.core.logging import get_logger
+        from keystone_tpu.observe import metrics
+
+        get_logger("keystone_tpu.resilience").warning(
+            "cluster: host(s) %s declared lost (%s) — exiting for "
+            "re-mesh; the supervisor restores from the last coordinated "
+            "checkpoint",
+            list(self._lost),
+            reason,
+        )
+        metrics.get_registry().counter("cluster_hosts_lost").inc(
+            len(self._lost)
+        )
+        metrics.get_registry().gauge("cluster_alive_hosts").set(
+            float(self.nprocs - len(self._lost))
+        )
+        emit_event(
+            "host_lost",
+            lost=list(self._lost),
+            reason=reason,
+            pid=self.pid,
+            step=self.step,
+            **fields,
+        )
+
+    # ----------------------------------------------------- lifecycle
+
+    def check(self) -> tuple[int, ...] | None:
+        """The train loop's poll: the lost host set once declared, else
+        None. A plain attribute read."""
+        return self._lost
+
+    def tick(self, now: float | None = None) -> None:
+        """One monitor iteration: publish, detect (host 0) or poll the
+        verdict (others), and escalate to a hard abort when the flagged
+        process failed to exit within the grace window (it is wedged in
+        a collective whose peer is dead — only ``os._exit`` still
+        works; the supervisor takes it from there)."""
+        now = self.clock() if now is None else now
+        self.beat_once(now)
+        if self.pid == 0:
+            self.detect_once(now)
+        else:
+            self.poll_lost_key(now)
+        if (
+            self._lost is not None
+            and not self._aborted
+            and self.abort_after_s > 0
+            and self._lost_at is not None
+            and now - self._lost_at > self.abort_after_s
+        ):
+            self._aborted = True
+            from keystone_tpu.core.logging import get_logger
+            from keystone_tpu.resilience.watchdog import dump_stacks
+
+            get_logger("keystone_tpu.resilience").critical(
+                "cluster: process still running %.1fs after host loss "
+                "(blocked collective?) — hard abort for supervisor "
+                "relaunch; thread stacks:\n%s",
+                now - self._lost_at,
+                dump_stacks(),
+            )
+            emit_event(
+                "host_loss_abort",
+                lost=list(self._lost),
+                pid=self.pid,
+                grace_s=self.abort_after_s,
+            )
+            self.abort(EXIT_HOST_LOST)
+
+    def start(self) -> "ClusterMonitor":
+        self._thread = threading.Thread(
+            target=self._run, name="cluster-monitor", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — the monitor must outlive
+                # any single bad iteration (a torn KV payload, a jax
+                # teardown race); detection resumes next tick
+                from keystone_tpu.core.logging import get_logger
+
+                get_logger("keystone_tpu.resilience").exception(
+                    "cluster monitor tick failed; continuing"
+                )
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
+# Module-global monitor, the faults.active() idiom: the train loop's
+# per-step hooks are one global read when no monitor is running.
+_monitor: ClusterMonitor | None = None
+_state_lock = threading.Lock()
+
+
+def start_monitor(
+    process_id: int | None = None,
+    num_processes: int | None = None,
+    kv=None,
+    **kwargs: Any,
+) -> ClusterMonitor | None:
+    """Start this process's membership monitor (idempotent). Resolves
+    pid/nprocs from the jax runtime when not given; returns None — and
+    starts nothing — for a single-process run or when no coordination
+    service exists (nothing to monitor, nothing to publish to)."""
+    global _monitor
+    if process_id is None or num_processes is None:
+        try:
+            import jax
+
+            num_processes = jax.process_count()
+            process_id = jax.process_index()
+        except Exception:  # noqa: BLE001 — backend init failure
+            return None
+    if num_processes <= 1 and kv is None:
+        return None
+    if kv is None:
+        kv = coordination_kv()
+        if kv is None:
+            from keystone_tpu.core.logging import get_logger
+
+            get_logger("keystone_tpu.resilience").warning(
+                "cluster: no coordination service (jax.distributed not "
+                "initialized?) — membership monitoring disabled"
+            )
+            return None
+    with _state_lock:
+        if _monitor is not None:
+            return _monitor
+        _monitor = ClusterMonitor(
+            kv, process_id, num_processes, **kwargs
+        ).start()
+        emit_event(
+            "monitor_start",
+            pid=process_id,
+            hosts=num_processes,
+            interval_s=_monitor.interval_s,
+            timeout_s=_monitor.timeout_s,
+        )
+        return _monitor
+
+
+def active_monitor() -> ClusterMonitor | None:
+    return _monitor
+
+
+def stop_monitor() -> None:
+    global _monitor
+    with _state_lock:
+        mon, _monitor = _monitor, None
+    if mon is not None:
+        mon.stop()
+
+
+def note_step(step: int) -> None:
+    """Per-step progress hook for training loops — ONE global read when
+    no monitor is active."""
+    mon = _monitor
+    if mon is not None:
+        mon.note_step(step)
+
+
+def check_lost() -> tuple[int, ...] | None:
+    """The train loop's membership poll: lost host pids once declared,
+    else None. ONE global read when no monitor is active."""
+    mon = _monitor
+    if mon is not None:
+        return mon.check()
+    return None
+
+
+def checkpoint_barrier(step: int, timeout_s: float | None = None) -> bool:
+    """Agreement point before a coordinated checkpoint save: every host
+    must arrive at ``step``'s save before any host starts writing, so a
+    dead or wedged peer turns into a loud :class:`ClusterBarrierError`
+    (bounded by ``KEYSTONE_CKPT_BARRIER_S``) instead of a torn
+    checkpoint or an unbounded hang. No-op (returns False) for
+    single-process runs and runs without a coordination service."""
+    try:
+        import jax
+
+        nprocs = jax.process_count()
+    except Exception:  # noqa: BLE001 — backend init failure
+        return False
+    if nprocs <= 1:
+        return False
+    from keystone_tpu.parallel.multihost import _coordination_client
+
+    client = _coordination_client()
+    if client is None:
+        return False
+    if timeout_s is None:
+        timeout_s = _env_float(ENV_CKPT_BARRIER_S, _DEFAULT_CKPT_BARRIER_S)
+    try:
+        client.wait_at_barrier(
+            f"keystone_ckpt_{int(step)}", int(timeout_s * 1000)
+        )
+    except Exception as e:  # noqa: BLE001 — wrapped with diagnosis
+        # message deliberately free of the transient RPC status words:
+        # retrying the save against a dead peer cannot succeed
+        raise ClusterBarrierError(
+            f"coordinated checkpoint barrier for step {step} failed "
+            f"after {timeout_s:.0f}s — a peer host died or wedged "
+            "mid-interval; falling back to the last intact checkpoint. "
+            f"Underlying error: {e!r}"
+        ) from e
+    return True
+
+
+def emit_event(action: str, **fields: Any) -> None:
+    """One ``cluster`` event + counter — the membership analog of the
+    resilience :func:`~keystone_tpu.resilience.emit.decision` schema,
+    rendered by ``observe <dir>`` and ``observe top``."""
+    from keystone_tpu.resilience.emit import decision
+
+    decision(
+        action,
+        counter="cluster_events",
+        counter_labels={"action": action},
+        event_kind="cluster",
+        phase="cluster",
+        **fields,
+    )

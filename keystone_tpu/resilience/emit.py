@@ -19,11 +19,15 @@ def decision(
     *,
     counter: str | None = None,
     counter_labels: dict[str, Any] | None = None,
+    event_kind: str = "resilience",
+    phase: str = "resilience",
     **fields: Any,
 ) -> None:
     """Record one resilience decision: bump ``counter`` (labeled) when
-    given, and emit a ``resilience`` event when a sink is active — one
-    global read when it isn't."""
+    given, and emit an event when a sink is active — one global read
+    when it isn't. ``event_kind`` defaults to ``resilience``; the
+    cluster-membership layer emits ``cluster`` events through the same
+    schema (:func:`keystone_tpu.resilience.cluster.emit_event`)."""
     from keystone_tpu.observe import events, metrics
 
     if counter:
@@ -32,4 +36,4 @@ def decision(
         ).inc()
     log = events.active()
     if log is not None:
-        log.emit("resilience", phase="resilience", action=action, **fields)
+        log.emit(event_kind, phase=phase, action=action, **fields)

@@ -64,6 +64,13 @@ SITES: dict[str, str] = {
     "completes (models/lm/train.py; key = step index)",
     "train.sigterm": "deliver a real SIGTERM to this process after the "
     "keyed train step (models/lm/train.py; key = step index)",
+    "cluster.heartbeat_drop": "skip publishing this host's membership "
+    "heartbeat at the keyed beat (resilience/cluster.py; key = beat "
+    "index)",
+    "cluster.host_kill": "SIGKILL this process after the keyed train "
+    "step — a sudden host death: no checkpoint, no cleanup "
+    "(models/lm/train.py; key = step index; `supervise` strips this "
+    "site on relaunch so the survivor set doesn't replay the kill)",
 }
 
 

@@ -1,0 +1,460 @@
+"""Run supervisor: ``python -m keystone_tpu supervise [opts] -- CMD``.
+
+The relaunch half of the elastic-multihost story
+(:mod:`keystone_tpu.resilience.cluster` is the detection half). The
+supervisor owns a set of child processes in one of two modes:
+
+**Single-box mode** (default): all ``--procs N`` cluster processes are
+children of this one supervisor (the 2-process CI drills, CPU/GPU test
+rigs). When a host dies (child killed by a signal it didn't get from
+us) or a survivor self-evacuates
+(:data:`~keystone_tpu.resilience.cluster.EXIT_HOST_LOST` /
+:data:`~keystone_tpu.resilience.cluster.EXIT_WEDGED`), the supervisor
+tears the generation down in bounded phases — wait for self-detection,
+then SIGTERM (the train loop's PR-2 handler checkpoints and exits),
+then SIGKILL — and relaunches on the surviving host set with recomputed
+``num_processes``; training resumes from the last coordinated
+checkpoint, losing at most one checkpoint interval of steps.
+
+**Pod mode** (``--coordinator HOST:PORT``): one supervisor per machine
+of a real pod, each owning only its local children, all agreeing on
+the shared jax coordination-service address. ``--world N`` is the
+TOTAL process count across machines (default ``--procs``) and
+``--base K`` this machine's first global process id, so the machine
+running global process 0 must use ``--base 0``. Without these flags a
+multi-machine launch would form N disjoint single-process "clusters"
+(each supervisor inventing its own ``localhost`` coordinator) — pod
+mode exists so that cannot happen silently. A per-machine supervisor
+cannot shrink the GLOBAL world on a loss (it only sees its own
+children), so pod mode always relaunches in place with the same
+world size (``--no-reduce`` semantics): a machine that lost its child
+restarts it, evacuated survivors rejoin, and training resumes from the
+last coordinated checkpoint. Elastic world-shrink is single-box mode's
+feature.
+
+Placeholders in CMD are substituted per child and recomputed on every
+relaunch: ``{pid}`` (global process id) ``{nprocs}`` (world size)
+``{port}`` ``{restart}``. Children also receive
+``KEYSTONE_SUPERVISED=1``, ``KEYSTONE_PROCESS_ID``,
+``KEYSTONE_NUM_PROCESSES``, ``KEYSTONE_COORDINATOR`` (a fresh
+``localhost:<port>`` per generation in single-box mode; the fixed
+``--coordinator`` address in pod mode) and ``KEYSTONE_RESTART``.
+
+``cluster.host_kill`` fault clauses are stripped from
+``KEYSTONE_FAULTS`` on relaunch: the site models a machine dying, and
+the relaunched survivor set must not replay the kill (the resumed run
+re-derives every step the dead incarnation never checkpointed).
+
+A child that exits nonzero with a NON-restartable code fails the whole
+supervision with that code — a deterministic bug must not be relaunched
+in a loop; ``--max-restarts`` bounds even the restartable kind.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+from keystone_tpu.resilience.cluster import (
+    EXIT_HOST_LOST,
+    RESTARTABLE_EXITS,
+)
+
+USAGE = """\
+usage: python -m keystone_tpu supervise [options] -- CMD [ARG...]
+options:
+  --procs N         processes (hosts) to launch locally  [default: 1]
+  --max-restarts R  relaunch budget across the run       [default: 3]
+  --grace S         seconds per teardown phase (self-detect -> SIGTERM
+                    -> SIGKILL) after a host loss        [default: 15]
+  --no-reduce       relaunch with the SAME process count (restart a
+                    rebooting host in place) instead of shrinking to
+                    the survivor set
+  --coordinator A   pod mode: HOST:PORT of the one shared jax
+                    coordination service (run one supervisor per
+                    machine; children join A instead of a private
+                    localhost coordinator). Implies --no-reduce: a
+                    per-machine supervisor restarts its children in
+                    place and cannot shrink the global world.
+  --world N         pod mode: TOTAL processes across all machines
+                                                        [default: --procs]
+  --base K          pod mode: global process id of this machine's
+                    first child (machine with process 0 uses 0)
+                                                        [default: 0]
+  --dry-run         print the resolved per-process commands and exit
+CMD placeholders, substituted per child and per generation:
+  {pid} (global id) {nprocs} (world size) {port} {restart}
+exit-code protocol (children): 0 done; 113 host-loss evacuation;
+114 watchdog wedge-abort; killed-by-signal = dead host; anything else
+is a real failure (not relaunched)."""
+
+
+def _free_port() -> int:
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _substitute(arg: str, mapping: dict) -> str:
+    # plain replace, not str.format: command lines legitimately carry
+    # other braces (json args, shell snippets)
+    for key, value in mapping.items():
+        arg = arg.replace("{%s}" % key, str(value))
+    return arg
+
+
+def scrub_host_kill(spec: str) -> str:
+    """Drop ``cluster.host_kill`` clauses from a ``KEYSTONE_FAULTS``
+    spec — the killed host stays dead; survivors must not replay it."""
+    clauses = [
+        c
+        for c in spec.split(",")
+        if c.strip() and not c.strip().startswith("cluster.host_kill")
+    ]
+    return ",".join(clauses)
+
+
+def _emit(action: str, **fields) -> None:
+    from keystone_tpu.resilience import cluster
+
+    cluster.emit_event(action, **fields)
+
+
+def resolve_commands(
+    cmd: list[str],
+    nprocs: int,
+    port: int,
+    restart: int,
+    world: int | None = None,
+    base: int = 0,
+) -> list[list[str]]:
+    """Per-child argv: ``{pid}`` substitutes the GLOBAL process id
+    (``base + local index``) and ``{nprocs}`` the world size, so the
+    same CMD works in single-box mode (base 0, world == nprocs) and in
+    pod mode (one supervisor per machine, each owning a slice of the
+    global id space)."""
+    world = nprocs if world is None else world
+    return [
+        [
+            _substitute(
+                a,
+                {
+                    "pid": base + pid,
+                    "nprocs": world,
+                    "port": port,
+                    "restart": restart,
+                },
+            )
+            for a in cmd
+        ]
+        for pid in range(nprocs)
+    ]
+
+
+def child_env(
+    env_base: dict,
+    pid: int,
+    nprocs: int,
+    coordinator: str,
+    restart: int,
+    world: int | None = None,
+    base: int = 0,
+) -> dict:
+    """The cluster wiring one child receives: all three of
+    ``KEYSTONE_COORDINATOR`` / ``KEYSTONE_PROCESS_ID`` /
+    ``KEYSTONE_NUM_PROCESSES`` are always exported together (consumed
+    as a group by :func:`keystone_tpu.parallel.multihost.initialize`,
+    which refuses a partial set). In pod mode every machine's
+    supervisor exports the SAME coordinator address and world size —
+    the exact invariant whose silent violation would split the pod
+    into disjoint single-process clusters."""
+    world = nprocs if world is None else world
+    env = dict(env_base)
+    env.update(
+        KEYSTONE_SUPERVISED="1",
+        KEYSTONE_PROCESS_ID=str(base + pid),
+        KEYSTONE_NUM_PROCESSES=str(world),
+        KEYSTONE_COORDINATOR=coordinator,
+        KEYSTONE_RESTART=str(restart),
+    )
+    return env
+
+
+def _run_generation(
+    cmd: list[str],
+    nprocs: int,
+    port: int,
+    restart: int,
+    grace_s: float,
+    env_base: dict,
+    coordinator: str | None = None,
+    world: int | None = None,
+    base: int = 0,
+) -> tuple[list[int], set[int]]:
+    """Launch one generation (one child per host), wait it out, and
+    return ``(returncodes, signaled)`` where ``signaled`` is the set of
+    pids WE terminated during teardown (their exit status says nothing
+    about the host — they were collateral, not casualties)."""
+    coord = coordinator or f"localhost:{port}"
+    children: list[subprocess.Popen] = []
+    for pid, args in enumerate(
+        resolve_commands(cmd, nprocs, port, restart, world, base)
+    ):
+        env = child_env(
+            env_base, pid, nprocs, coord, restart, world, base
+        )
+        children.append(subprocess.Popen(args, env=env))
+    signaled: set[int] = set()
+    # teardown phases, armed when the first child exits nonzero:
+    # [0, grace): survivors self-detect via heartbeats and evacuate
+    # [grace, 2*grace): SIGTERM — the train loop checkpoints and exits
+    # [2*grace, ...): SIGKILL — bounded even for a wedged collective
+    failed_at: float | None = None
+    phase = 0
+    while any(p.poll() is None for p in children):
+        if failed_at is None and any(
+            p.poll() is not None and p.returncode != 0 for p in children
+        ):
+            failed_at = time.monotonic()
+        if failed_at is not None:
+            elapsed = time.monotonic() - failed_at
+            if phase == 0 and elapsed >= grace_s:
+                phase = 1
+                for pid, p in enumerate(children):
+                    if p.poll() is None:
+                        signaled.add(pid)
+                        try:
+                            p.terminate()
+                        except OSError:
+                            pass
+            elif phase == 1 and elapsed >= 2 * grace_s:
+                phase = 2
+                for pid, p in enumerate(children):
+                    if p.poll() is None:
+                        signaled.add(pid)
+                        try:
+                            p.kill()
+                        except OSError:
+                            pass
+        time.sleep(0.1)
+    return [p.wait() for p in children], signaled
+
+
+def _opt_value(argv: list[str], i: int, cast=str):
+    """The value of option ``argv[i]`` — a missing or malformed value is
+    a usage error (clean SystemExit + USAGE), never a traceback."""
+    if i + 1 >= len(argv) or argv[i + 1] == "--":
+        raise SystemExit(f"option {argv[i]!r} needs a value\n{USAGE}")
+    try:
+        return cast(argv[i + 1])
+    except ValueError:
+        raise SystemExit(
+            f"option {argv[i]!r}: invalid value "
+            f"{argv[i + 1]!r}\n{USAGE}"
+        ) from None
+
+
+def main(argv: list[str] | None = None) -> None:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    procs, max_restarts, grace_s = 1, 3, 15.0
+    reduce_on_loss, dry_run = True, False
+    coordinator: str | None = None
+    world: int | None = None
+    base = 0
+    cmd: list[str] = []
+    i = 0
+    while i < len(argv):
+        arg = argv[i]
+        if arg == "--":
+            cmd = argv[i + 1 :]
+            break
+        if arg in ("-h", "--help"):
+            raise SystemExit(USAGE)
+        if arg == "--procs":
+            procs, i = _opt_value(argv, i, int), i + 2
+        elif arg == "--max-restarts":
+            max_restarts, i = _opt_value(argv, i, int), i + 2
+        elif arg == "--grace":
+            grace_s, i = _opt_value(argv, i, float), i + 2
+        elif arg == "--no-reduce":
+            reduce_on_loss, i = False, i + 1
+        elif arg == "--coordinator":
+            coordinator, i = _opt_value(argv, i), i + 2
+        elif arg == "--world":
+            world, i = _opt_value(argv, i, int), i + 2
+        elif arg == "--base":
+            base, i = _opt_value(argv, i, int), i + 2
+        elif arg == "--dry-run":
+            dry_run, i = True, i + 1
+        else:
+            raise SystemExit(f"unknown option {arg!r}\n{USAGE}")
+    if not cmd:
+        raise SystemExit(f"no command after '--'\n{USAGE}")
+    if procs < 1:
+        raise SystemExit(f"--procs {procs}: must be >= 1")
+    if coordinator is None:
+        if world is not None or base != 0:
+            raise SystemExit(
+                "--world/--base are pod-mode options and require "
+                "--coordinator (without it every supervisor invents its "
+                f"own localhost coordinator)\n{USAGE}"
+            )
+    else:
+        host, sep, port_s = coordinator.rpartition(":")
+        if not (sep and host and port_s.isdigit()):
+            raise SystemExit(
+                f"--coordinator {coordinator!r}: must be HOST:PORT"
+            )
+        if world is None:
+            world = procs
+        if base < 0 or base + procs > world:
+            raise SystemExit(
+                f"--base {base} + --procs {procs} exceeds --world "
+                f"{world}: this machine's global ids "
+                f"[{base}, {base + procs}) must fit in the world"
+            )
+        if reduce_on_loss:
+            print(
+                "[supervise] pod mode (--coordinator): relaunching in "
+                "place with the same world size — a per-machine "
+                "supervisor cannot shrink the global world",
+                file=sys.stderr,
+            )
+            reduce_on_loss = False
+
+    if dry_run:
+        port = (
+            int(coordinator.rpartition(":")[2])
+            if coordinator
+            else _free_port()
+        )
+        coord = coordinator or f"localhost:{port}"
+        eff_world = world if world is not None else procs
+        for pid, args in enumerate(
+            resolve_commands(cmd, procs, port, 0, world, base)
+        ):
+            print(
+                f"[supervise --dry-run] pid {base + pid}/{eff_world} "
+                f"(coordinator {coord}): " + " ".join(args)
+            )
+        return
+
+    env_base = dict(os.environ)
+    nprocs = procs
+    restarts = 0
+    while True:
+        # pod mode: {port} substitutes the shared coordinator's port so
+        # the same CMD works in both modes; single-box picks a fresh
+        # private port per generation (stale peers from the previous
+        # generation can never rejoin the new cluster)
+        port = (
+            int(coordinator.rpartition(":")[2])
+            if coordinator
+            else _free_port()
+        )
+        coord = coordinator or f"localhost:{port}"
+        print(
+            f"[supervise] generation {restarts}: launching {nprocs} "
+            f"process(es), coordinator {coord}",
+            file=sys.stderr,
+            flush=True,
+        )
+        _emit(
+            "supervise_launch",
+            hosts=nprocs,
+            restart=restarts,
+            port=port,
+        )
+        rcs, signaled = _run_generation(
+            cmd,
+            nprocs,
+            port,
+            restarts,
+            grace_s,
+            env_base,
+            coordinator,
+            world,
+            base,
+        )
+        if all(rc == 0 for rc in rcs):
+            _emit("supervise_complete", hosts=nprocs, restart=restarts)
+            print("[supervise] job complete", file=sys.stderr)
+            return
+        # classify the casualties: a child killed by a signal WE did not
+        # send is a dead host (drops out of the membership); a child
+        # exiting EXIT_HOST_LOST / EXIT_WEDGED evacuated or wedged and
+        # stays a member; any other nonzero exit is a real failure
+        dead = [
+            pid
+            for pid, rc in enumerate(rcs)
+            if rc < 0 and pid not in signaled
+        ]
+        evacuated = [
+            pid for pid, rc in enumerate(rcs) if rc in RESTARTABLE_EXITS
+        ]
+        hard = [
+            pid
+            for pid, rc in enumerate(rcs)
+            if rc > 0 and rc not in RESTARTABLE_EXITS
+            and pid not in signaled
+        ]
+        if hard and not dead:
+            # a bug exit with NO actually-dead host is deterministic —
+            # peers evacuating (113) is a symptom of the crash, not a
+            # membership change, so relaunching would replay the bug
+            # until the budget burns and mask the real exit code
+            rc = rcs[hard[0]]
+            print(
+                f"[supervise] process(es) {hard} failed (exit "
+                f"{rc}) with no host loss — not a relaunchable "
+                "condition, giving up",
+                file=sys.stderr,
+            )
+            _emit("supervise_failed", failed=hard, exit=rc)
+            raise SystemExit(rc)
+        survivors = nprocs - len(dead) if reduce_on_loss else nprocs
+        survivors = max(survivors, 1)
+        restarts += 1
+        _emit(
+            "supervise_host_lost",
+            dead=dead,
+            evacuated=evacuated,
+            exits=rcs,
+            survivors=survivors,
+        )
+        if restarts > max_restarts:
+            print(
+                f"[supervise] restart budget exhausted "
+                f"({max_restarts}) — giving up",
+                file=sys.stderr,
+            )
+            _emit("supervise_giveup", restarts=restarts - 1)
+            raise SystemExit(EXIT_HOST_LOST)
+        spec = env_base.get("KEYSTONE_FAULTS", "")
+        if spec:
+            env_base["KEYSTONE_FAULTS"] = scrub_host_kill(spec)
+            if not env_base["KEYSTONE_FAULTS"]:
+                env_base.pop("KEYSTONE_FAULTS")
+        print(
+            f"[supervise] host(s) {dead} lost (evacuated: {evacuated}, "
+            f"exits: {rcs}); relaunching on {survivors} process(es), "
+            f"restart {restarts}/{max_restarts}",
+            file=sys.stderr,
+            flush=True,
+        )
+        _emit(
+            "supervise_relaunch",
+            survivors=survivors,
+            restart=restarts,
+            dead=dead,
+        )
+        nprocs = survivors
+
+
+if __name__ == "__main__":
+    main()

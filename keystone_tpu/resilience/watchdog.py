@@ -13,6 +13,14 @@ the watchdog's own failure mode).
 One stall fires once; the next pet re-arms it, so a recovered loop that
 stalls again later is reported again.
 
+``escalate_after=N`` upgrades diagnosis to action: after N consecutive
+timeout periods with no pet, the watchdog dumps every thread stack one
+final time and hard-aborts the process (``os._exit`` with
+:data:`keystone_tpu.resilience.cluster.EXIT_WEDGED`). A wedged main
+thread would otherwise keep the cluster heartbeat daemon alive forever
+— the host looks healthy to the failure detector while contributing
+nothing — so fast-failing is what lets the run supervisor relaunch it.
+
 The multihost init hang is handled differently — JAX's coordinator
 already owns a timeout, so :func:`keystone_tpu.parallel.multihost.
 initialize` passes it through and wraps the failure with the
@@ -21,6 +29,7 @@ coordinator address; see that module.
 
 from __future__ import annotations
 
+import os
 import sys
 import threading
 import time
@@ -62,12 +71,23 @@ class Watchdog:
         on_stall: Callable[[], None] | None = None,
         poll_s: float | None = None,
         clock: Callable[[], float] = time.monotonic,
+        escalate_after: int | None = None,
+        abort: Callable[[int], None] | None = None,
     ):
         if timeout_s <= 0:
             raise ValueError(f"timeout_s={timeout_s}: must be > 0")
+        if escalate_after is not None and escalate_after < 1:
+            raise ValueError(
+                f"escalate_after={escalate_after}: must be >= 1"
+            )
         self.timeout_s = timeout_s
         self.label = label
         self.on_stall = on_stall
+        self.escalate_after = escalate_after
+        # injectable for tests; production default is os._exit — a
+        # wedged interpreter may not run atexit/finally anyway, and the
+        # point is to die fast enough to trip the failure detector
+        self._abort = abort if abort is not None else os._exit
         self.poll_s = poll_s if poll_s is not None else max(timeout_s / 4, 0.01)
         self.clock = clock
         self.stalls = 0
@@ -111,14 +131,52 @@ class Watchdog:
 
     def _monitor(self) -> None:
         while not self._stop.wait(self.poll_s):
+            escalate = False
             with self._lock:
                 idle = self.clock() - self._last_pet
                 stalled = idle > self.timeout_s and not self._flagged
                 if stalled:
                     self._flagged = True
                     self.stalls += 1
+                # "consecutive stalls" = full timeout periods since the
+                # last pet; a single pet resets the count to zero
+                if (
+                    self.escalate_after is not None
+                    and idle // self.timeout_s >= self.escalate_after
+                ):
+                    escalate = True
             if stalled:
                 self._report(idle)
+            if escalate:
+                self._escalate(idle)
+                return  # unreachable with the real os._exit abort;
+                # injected test aborts must not re-fire every poll
+
+    def _escalate(self, idle: float) -> None:
+        from keystone_tpu.core.logging import get_logger
+        from keystone_tpu.resilience.cluster import EXIT_WEDGED
+        from keystone_tpu.resilience.emit import decision
+
+        get_logger("keystone_tpu.resilience").critical(
+            "%s: no progress for %.1fs (%d consecutive %.1fs timeouts) "
+            "— this host is wedged; hard-aborting so the failure "
+            "detector / supervisor can replace it. Thread stacks:\n%s",
+            self.label,
+            idle,
+            self.escalate_after,
+            self.timeout_s,
+            dump_stacks(),
+        )
+        decision(
+            "watchdog_abort",
+            counter="watchdog_aborts",
+            counter_labels={"label": self.label},
+            label=self.label,
+            idle_s=idle,
+            timeout_s=self.timeout_s,
+            escalate_after=self.escalate_after,
+        )
+        self._abort(EXIT_WEDGED)
 
     def _report(self, idle: float) -> None:
         from keystone_tpu.core.logging import get_logger

@@ -1,0 +1,70 @@
+"""Worker for the single-process supervised host-kill drill.
+
+NOT a test module (no ``test_`` prefix): ``test_cluster.py`` runs it
+under ``python -m keystone_tpu supervise`` with
+``KEYSTONE_FAULTS="cluster.host_kill:@3:0"`` in the environment. The
+full LM train loop (``models/lm/train.py`` — checkpointing, fault
+sites, cluster hooks) runs 8 steps with a checkpoint every 2; the
+injected host kill SIGKILLs the process after step 4 completes but
+before its save, so the relaunched incarnation must resume from the
+step-2 coordinated checkpoint and replay the identical trajectory.
+
+Writes ``<out>.npz`` (losses of the final incarnation + params) on
+success.
+
+Usage: python elastic_train_worker.py <out> <ckpt_dir>
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+STEPS, BATCH, SEQ, VOCAB = 8, 4, 16, 31
+
+
+def build_model():
+    from keystone_tpu.models import lm_transformer as lm
+
+    model = lm.TransformerLM.create(
+        jax.random.key(0), vocab=VOCAB, max_seq=SEQ, dim=16, depth=1,
+        num_heads=2,
+    )
+    corpus = lm.synthetic_corpus(4_000, VOCAB, seed=0)
+    return model, corpus
+
+
+def main() -> None:
+    out, ckdir = sys.argv[1], sys.argv[2]
+    import numpy as np
+
+    from keystone_tpu.models.lm.train import train
+
+    model, corpus = build_model()
+    model, losses = train(
+        model,
+        corpus,
+        steps=STEPS,
+        batch=BATCH,
+        seq=SEQ,
+        lr=1e-3,
+        seed=0,
+        checkpoint_dir=ckdir,
+        checkpoint_every=2,
+    )
+    np.savez(
+        out,
+        losses=np.asarray(losses, np.float64),
+        wq=np.asarray(model.blocks[0].wq),
+        embed=np.asarray(model.embed),
+    )
+    print("elastic_train_worker: ok", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,157 @@
+"""Worker for the 2-process elastic host-loss drill.
+
+NOT a test module (no ``test_`` prefix): ``test_cluster.py`` runs it
+under ``python -m keystone_tpu supervise --procs 2`` with the
+``{pid} {nprocs} {port}`` placeholders. Both processes join one
+jax.distributed runtime, start the cluster membership monitor (fast
+drill cadence), and train the shared tiny LM on global dp batches with
+coordinated checkpoints every 2 steps. The victim (pid 1) SIGKILLs
+itself after ``kill_step`` completes — a real mid-train host death.
+The survivor detects the loss over heartbeats and evacuates with
+``EXIT_HOST_LOST`` (or is hard-aborted by the monitor if it wedged in
+a dead collective); the supervisor then relaunches on the survivor set
+(``nprocs=1``) and the resumed run restores the last coordinated
+checkpoint and finishes.
+
+Exit codes: 0 ok; 42 the rig cannot join a 2-process jax.distributed
+runtime (the test skips); EXIT_HOST_LOST (113) host-loss evacuation;
+killed-by-SIGKILL = the drilled death.
+
+Usage: python multihost_elastic_worker.py <pid> <nprocs> <port> <out>
+       <ckpt_dir> [kill_step]
+"""
+
+import os
+import signal
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from _lm_worker_common import BATCH, build, step_batch  # noqa: E402
+
+STEPS, EVERY = 8, 2
+
+
+def _rig_cannot(e: Exception) -> bool:
+    """A backend that can't run multiprocess computations at all is the
+    same skip family as a failed jax.distributed init."""
+    return "Multiprocess computations aren't implemented" in repr(e)
+
+
+def main() -> None:
+    pid, nprocs, port, out_path, ckdir = (
+        int(sys.argv[1]),
+        int(sys.argv[2]),
+        sys.argv[3],
+        sys.argv[4],
+        sys.argv[5],
+    )
+    kill_step = int(sys.argv[6]) if len(sys.argv) > 6 else 0
+    import numpy as np
+
+    from keystone_tpu.core.checkpoint import TrainCheckpointer
+    from keystone_tpu.parallel import multihost
+    from keystone_tpu.parallel.mesh import create_mesh
+    from keystone_tpu.resilience import cluster
+
+    if nprocs > 1:
+        try:
+            multihost.initialize(
+                coordinator_address=f"localhost:{port}",
+                num_processes=nprocs,
+                process_id=pid,
+                init_timeout_s=60,
+            )
+        except RuntimeError as e:
+            print(f"INIT_FAILED: {e}", flush=True)
+            sys.exit(42)
+        # probe real cross-process collectives BEFORE entering the
+        # elastic protocol: a rig that can't run them (CPU backend)
+        # must skip symmetrically in both processes, not die mid-drill
+        try:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("keystone_elastic_probe")
+        except Exception as e:  # noqa: BLE001 — rig limitation
+            print(f"INIT_FAILED: {e!r}", flush=True)
+            sys.exit(42)
+        # drill cadence: detect within ~2s, hard-abort a wedged
+        # survivor ~4s after that — the whole loss fits CI budgets
+        cluster.start_monitor(
+            interval_s=0.25, timeout_s=2.0, abort_after_s=4.0
+        )
+    mesh = create_mesh(data=jax.device_count())
+
+    model, optimizer, step, corpus = build()
+    opt_state = optimizer.init(model)
+    try:
+        # orbax's manager syncs the host set with real collectives;
+        # a rig whose backend can't run them (CPU multiprocess) can't
+        # drill host loss either — same skip family as a failed init
+        ckpt = TrainCheckpointer(
+            ckdir,
+            {"kind": "elastic_lm", "batch": BATCH},
+            cluster_info={"num_processes": nprocs},
+        )
+    except Exception as e:  # noqa: BLE001 — classify rig limitation
+        if _rig_cannot(e):
+            print(f"INIT_FAILED: {e!r}", flush=True)
+            sys.exit(42)
+        raise
+    losses = []
+    try:
+        (model, opt_state), start = ckpt.restore((model, opt_state))
+        lo, hi = pid * BATCH // nprocs, (pid + 1) * BATCH // nprocs
+        for i in range(start, STEPS):
+            toks = step_batch(corpus, i)
+            g_toks = multihost.global_batch_from_local(
+                np.ascontiguousarray(toks[lo:hi]), mesh
+            )
+            model, opt_state, loss = step(model, opt_state, g_toks)
+            losses.append(float(loss))
+            cluster.note_step(i + 1)
+            if kill_step and nprocs > 1 and pid == 1 and i + 1 == kill_step:
+                # the drilled host death: after the step, before its
+                # save — the survivors must lose (and replay) the
+                # in-interval steps
+                os.kill(os.getpid(), signal.SIGKILL)
+            lost = cluster.check_lost()
+            if lost is not None:
+                raise cluster.HostLostError(lost)
+            if (i + 1) % EVERY == 0:
+                ckpt.save((model, opt_state), i + 1)
+    except cluster.ClusterError as e:
+        print(f"HOST_LOST: {e}", flush=True)
+        sys.exit(cluster.EXIT_HOST_LOST)
+    except Exception as e:  # noqa: BLE001 — a dead peer can also
+        # surface as a failed collective before the detector's verdict;
+        # classify by what the monitor knows
+        if _rig_cannot(e):
+            print(f"INIT_FAILED: {e!r}", flush=True)
+            sys.exit(42)
+        if cluster.check_lost() is not None:
+            print(f"HOST_LOST (collective failure): {e!r}", flush=True)
+            sys.exit(cluster.EXIT_HOST_LOST)
+        raise
+    finally:
+        ckpt.close()
+        cluster.stop_monitor()
+
+    if pid == 0:
+        np.savez(
+            out_path,
+            losses=np.asarray(losses, np.float64),
+            start=np.int64(start),
+            wq=np.asarray(model.blocks[0].wq),
+            embed=np.asarray(model.embed),
+        )
+    print(f"elastic worker {pid}: ok (resumed from {start})", flush=True)
+
+
+if __name__ == "__main__":
+    main()

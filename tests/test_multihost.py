@@ -16,6 +16,9 @@ import time
 from pathlib import Path
 
 import numpy as np
+import pytest
+
+pytestmark = pytest.mark.multihost
 
 WORKER = Path(__file__).with_name("multihost_worker.py")
 ATTN_WORKER = Path(__file__).with_name("multihost_attention_worker.py")

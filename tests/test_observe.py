@@ -791,6 +791,7 @@ def test_rollup_metrics_single_host_writes_cluster_file(tmp_path):
     assert "cluster metrics roll-up" in render(run_dir)
 
 
+@pytest.mark.multihost
 def test_multihost_metrics_rollup_two_processes(tmp_path, free_tcp_port):
     """Two real processes: each records host-local metrics, host 0
     gathers over the coordination service and writes cluster totals
