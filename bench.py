@@ -873,6 +873,108 @@ def bench_lm_step_telemetry() -> dict:
     return out
 
 
+def bench_serve_latency(
+    n_requests: int = 48,
+    fit_n: int = 512,
+    max_new: int = 48,
+    streams: int = 8,
+) -> dict:
+    """Online-serving record (serve/ subsystem): micro-batched request
+    latency percentiles + batch-fill through the AOT-exported mnist demo
+    pipeline, and continuous-batching decode aggregate-vs-single-stream
+    tokens/s over the SAME workload (N prompts through a 1-slot pool vs
+    an N-slot pool — the serialized and continuous schedules of the same
+    token budget). Deliberately small — runs on the CPU fallback too."""
+    import concurrent.futures
+    import time as _time
+
+    import jax
+
+    from keystone_tpu.models.lm.model import TransformerLM
+    from keystone_tpu.observe import metrics as observe_metrics
+    from keystone_tpu.observe.telemetry import percentiles
+    from keystone_tpu.serve.decode_loop import DecodeLoop
+    from keystone_tpu.serve.export import ExportedApply
+    from keystone_tpu.serve.queue import MicroBatcher
+    from keystone_tpu.serve.server import _fit_mnist_demo
+
+    out: dict = {}
+    reg = observe_metrics.get_registry()
+    rng = np.random.default_rng(0)
+
+    # ---- request path: burst of concurrent /predict-shaped requests
+    pipe, sample = _fit_mnist_demo(fit_n)
+    exported = ExportedApply(pipe, sample, buckets=(1, 8, 32))
+    out["cold_start_s"] = round(exported.cold_start_s, 3)
+    snap0 = reg.snapshot()
+    batcher = MicroBatcher(
+        exported, buckets=exported.buckets, deadline_ms=10.0
+    )
+    row_shape = sample.shape[1:]
+    reqs = [
+        rng.normal(size=(int(rng.integers(1, 5)), *row_shape)).astype(
+            np.float32
+        )
+        for _ in range(n_requests)
+    ]
+    lat: list[float] = []
+
+    def one(rows):
+        t0 = _time.perf_counter()
+        batcher.submit(rows).result(timeout=120.0)
+        return _time.perf_counter() - t0
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=16) as pool:
+        lat = list(pool.map(one, reqs))
+    batcher.close(drain=True)
+    snap1 = reg.snapshot()
+
+    def delta(name):
+        return (snap1.get(name) or 0) - (snap0.get(name) or 0)
+
+    p = percentiles(lat, (50, 95))
+    n_rows = delta("serve_rows")
+    pad_rows = delta("serve_pad_rows")
+    out.update(
+        requests=n_requests,
+        request_p50_ms=round(p[50] * 1e3, 2),
+        request_p95_ms=round(p[95] * 1e3, 2),
+        batches=int(delta("serve_batches")),
+        batch_fill=round(n_rows / max(n_rows + pad_rows, 1), 4),
+    )
+
+    # ---- decode path: the same token budget, serialized vs continuous
+    model = TransformerLM.create(
+        jax.random.key(0), vocab=256, max_seq=160, dim=64, depth=2,
+        num_heads=4,
+    )
+    prompts = [
+        rng.integers(1, 256, size=int(rng.integers(4, 12)), dtype=np.int32)
+        for _ in range(streams)
+    ]
+
+    def agg_rate(slots: int) -> float:
+        loop = DecodeLoop(
+            model, slots=slots, s_max=160, max_new=max_new,
+            prefill_buckets=(16,),
+        )
+        loop.warm()
+        t0 = _time.perf_counter()
+        loop.run(prompts)
+        wall = _time.perf_counter() - t0
+        return loop.tokens_out / wall
+
+    single = agg_rate(1)
+    multi = agg_rate(streams)
+    out.update(
+        decode_single_stream_tokens_per_s=round(single, 1),
+        decode_concurrent_tokens_per_s=round(multi, 1),
+        decode_streams=streams,
+        aggregate_vs_single=round(multi / single, 2),
+    )
+    return out
+
+
 def bench_sift() -> dict:
     """Dense-SIFT featurize, device (XLA) path, with the C++ host kernel
     (native/dsift.cpp, the VLFeat-shim parity fallback) as baseline."""
@@ -1189,6 +1291,15 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — telemetry must not cost the
         # bench its headline number
         result["lm_step_telemetry"] = {
+            "error": f"{type(e).__name__}: {str(e)[:200]}"
+        }
+    # online-serving record (serve/ subsystem): micro-batched request
+    # latency + batch fill, and continuous-batching decode aggregate vs
+    # single-stream tokens/s — runs on the CPU fallback too
+    try:
+        result["serve_latency"] = bench_serve_latency()
+    except Exception as e:  # noqa: BLE001 — same contract as above
+        result["serve_latency"] = {
             "error": f"{type(e).__name__}: {str(e)[:200]}"
         }
     # per-node operator breakdown (observe subsystem): wall time per

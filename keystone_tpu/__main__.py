@@ -57,6 +57,7 @@ COMMANDS = {
     "faults": "keystone_tpu.resilience.faults",
     "plan": "keystone_tpu.plan.cli",
     "supervise": "keystone_tpu.resilience.supervisor",
+    "serve": "keystone_tpu.serve.server",
 }
 
 
@@ -102,7 +103,8 @@ def main(argv: list[str] | None = None) -> None:
             f" prints the KEYSTONE_FAULTS injection sites; `plan <model>`\n"
             f" prints the cost-based planner's chosen plan without executing;\n"
             f" `supervise -- CMD` relaunches a multihost job on host loss —\n"
-            f" see `supervise --help`)"
+            f" see `supervise --help`; `serve <model> [--port N]` serves a\n"
+            f" fitted pipeline or LM over HTTP/JSON — see `serve --help`)"
         )
     if argv[0] in COMMANDS:
         import importlib

@@ -6,6 +6,19 @@ artifacts (SURVEY.md §5 checkpoint/resume); those formats are kept (see
 pytree of arrays + static config, whole pipelines additionally checkpoint
 generically: leaves are pulled to host numpy and pickled with the dataclass
 structure, so ``load_pipeline`` returns a ready-to-jit pipeline.
+
+Two formats:
+
+- :func:`save_pipeline` / :func:`load_pipeline` — the classic bare
+  pickle (kept for existing checkpoints).
+- :func:`save_fitted` / :func:`load_fitted` — the *serving* format: the
+  pickle travels with a structural **spec** (pytree structure + per-leaf
+  shape/dtype). ``load_fitted`` re-derives the spec from the loaded
+  object and fails loudly with :class:`PipelineSpecError` when they have
+  drifted — a server must refuse to serve a pipeline whose node classes
+  changed shape underneath the checkpoint, not discover it request-by-
+  request (same posture as ``core/checkpoint.py``'s
+  ``CheckpointMismatchError`` on restore).
 """
 
 from __future__ import annotations
@@ -16,13 +29,26 @@ import jax
 import numpy as np
 
 _MAGIC = b"KSTP1\n"
+_MAGIC_FITTED = b"KSTF1\n"
+
+
+class PipelineSpecError(ValueError):
+    """The saved pipeline's structure disagrees with what the current
+    code reconstructs — different node classes, leaf count, or leaf
+    shapes/dtypes. Loud by design: spec drift served silently would
+    return plausible-but-wrong predictions. Subclasses ValueError like
+    ``CheckpointMismatchError`` so generic callers keep working."""
+
+
+def _to_host(node):
+    return jax.tree_util.tree_map(
+        lambda leaf: np.asarray(leaf) if hasattr(leaf, "shape") else leaf, node
+    )
 
 
 def save_pipeline(node, path: str) -> None:
     """Persist a fitted Transformer/Pipeline (any pytree node) to ``path``."""
-    host = jax.tree_util.tree_map(
-        lambda leaf: np.asarray(leaf) if hasattr(leaf, "shape") else leaf, node
-    )
+    host = _to_host(node)
     with open(path, "wb") as f:
         f.write(_MAGIC)
         pickle.dump(host, f, protocol=pickle.HIGHEST_PROTOCOL)
@@ -30,9 +56,94 @@ def save_pipeline(node, path: str) -> None:
 
 def load_pipeline(path: str):
     """Load a pipeline saved by :func:`save_pipeline`; arrays return as
-    device arrays on first use (jnp.asarray on apply)."""
+    device arrays on first use (jnp.asarray on apply).
+
+    Also accepts the :func:`save_fitted` format (the spec is then
+    verified exactly as :func:`load_fitted` would)."""
     with open(path, "rb") as f:
         magic = f.read(len(_MAGIC))
+        if magic == _MAGIC_FITTED:
+            return _load_fitted_fh(f, path)
         if magic != _MAGIC:
             raise ValueError(f"{path} is not a keystone_tpu pipeline checkpoint")
         return pickle.load(f)
+
+
+def pipeline_spec(node) -> dict:
+    """The structural identity of a fitted pipeline: the pytree
+    structure string (node classes + static config) plus each leaf's
+    shape and dtype. Everything that determines the compiled program —
+    and nothing that depends on the weights' values — so two fits of the
+    same architecture share a spec but any code-level drift changes it."""
+    leaves, treedef = jax.tree_util.tree_flatten(node)
+    return {
+        "version": 1,
+        "structure": str(treedef),
+        "leaves": [
+            {
+                "shape": list(getattr(leaf, "shape", ())),
+                "dtype": str(getattr(leaf, "dtype", type(leaf).__name__)),
+            }
+            for leaf in leaves
+        ],
+    }
+
+
+def _spec_drift(saved: dict, current: dict) -> str | None:
+    """First human-readable difference between two specs, or None."""
+    if saved.get("structure") != current.get("structure"):
+        return (
+            "pytree structure differs\n"
+            f"  saved:  {saved.get('structure')}\n"
+            f"  loaded: {current.get('structure')}"
+        )
+    a, b = saved.get("leaves", []), current.get("leaves", [])
+    if len(a) != len(b):
+        return f"leaf count differs: saved {len(a)}, loaded {len(b)}"
+    for i, (la, lb) in enumerate(zip(a, b)):
+        if la != lb:
+            return f"leaf {i} differs: saved {la}, loaded {lb}"
+    return None
+
+
+def save_fitted(node, path: str, **meta) -> dict:
+    """Persist a *fitted* pipeline with its structural spec so a server
+    can load it without refitting — and refuse it if the code drifted.
+    Extra ``meta`` keys (fit corpus, date, metrics) ride along verbatim.
+    Returns the spec that was written."""
+    spec = pipeline_spec(node)
+    payload = {"spec": spec, "meta": meta, "tree": _to_host(node)}
+    with open(path, "wb") as f:
+        f.write(_MAGIC_FITTED)
+        pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+    return spec
+
+
+def _load_fitted_fh(f, path: str, with_meta: bool = False):
+    payload = pickle.load(f)
+    node = payload["tree"]
+    drift = _spec_drift(payload.get("spec") or {}, pipeline_spec(node))
+    if drift:
+        raise PipelineSpecError(
+            f"{path}: fitted-pipeline spec drift — the checkpoint was "
+            f"written by different code than just reconstructed it; "
+            f"refusing to serve it ({drift})"
+        )
+    if with_meta:
+        return node, payload.get("meta") or {}
+    return node
+
+
+def load_fitted(path: str, with_meta: bool = False):
+    """Load a pipeline saved by :func:`save_fitted`, verifying the
+    stored spec against the reconstructed object. ``with_meta=True``
+    returns ``(node, meta)``. Raises :class:`PipelineSpecError` on any
+    structural drift."""
+    with open(path, "rb") as f:
+        magic = f.read(len(_MAGIC_FITTED))
+        if magic != _MAGIC_FITTED:
+            raise ValueError(
+                f"{path} is not a keystone_tpu fitted-pipeline checkpoint "
+                "(for bare save_pipeline files use load_pipeline)"
+            )
+        return _load_fitted_fh(f, path, with_meta=with_meta)

@@ -65,12 +65,22 @@ def _kv_quant(t):
 
 
 def prefill(model: TransformerLM, tokens, s_max: int,
-            kv_dtype: str | None = None):
+            kv_dtype: str | None = None, lengths=None):
     """Run the prompt through the model once, capturing per-layer K/V into
     an ``s_max``-long cache (optionally int8 — see :class:`KVCache`).
     Returns (last-position logits (B, V), cache). Local attention only
     (sequence-parallel decode shards the cache — use ring/Ulysses for
-    training, gather to local for decode)."""
+    training, gather to local for decode).
+
+    ``lengths`` ((B,) int32) admits a batch of unequal-length prompts
+    right-padded to a common width: logits are gathered at each
+    sequence's own last real token (``lengths - 1``) and the cache comes
+    back with a *per-sequence* ``pos`` vector, so decode resumes each
+    row at its own position. Causal attention already keeps right-pad
+    K/V out of every real token's view, and decode overwrites the pad
+    region before its positions ever become valid — no mask plumbing
+    needed (the positions past ``pos`` are excluded by
+    :func:`decode_step`'s validity mask)."""
     if model.seq_mode != "local":
         raise ValueError("prefill/decode require seq_mode='local'")
     if kv_dtype not in (None, "int8"):
@@ -89,21 +99,25 @@ def prefill(model: TransformerLM, tokens, s_max: int,
         )
         ks.append(k)
         vs.append(v)
-    logits = _tied_logits(x[:, -1:], model.embed, cdt)[:, 0]
+    if lengths is None:
+        logits = _tied_logits(x[:, -1:], model.embed, cdt)[:, 0]
+        pos = jnp.asarray(s, jnp.int32)
+    else:
+        lengths = jnp.asarray(lengths, jnp.int32)
+        last = jnp.take_along_axis(
+            x, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1
+        )  # (B, 1, d) — each row's own final real token
+        logits = _tied_logits(last, model.embed, cdt)[:, 0]
+        pos = lengths
     pad = [(0, 0), (0, 0), (0, s_max - s), (0, 0)]
     k_stack = jnp.stack([jnp.pad(k, pad) for k in ks])
     v_stack = jnp.stack([jnp.pad(v, pad) for v in vs])
     if kv_dtype == "int8":
         kq, ksc = _kv_quant(k_stack)
         vq, vsc = _kv_quant(v_stack)
-        cache = KVCache(
-            k=kq, v=vq, pos=jnp.asarray(s, jnp.int32),
-            k_scale=ksc, v_scale=vsc,
-        )
+        cache = KVCache(k=kq, v=vq, pos=pos, k_scale=ksc, v_scale=vsc)
     else:
-        cache = KVCache(
-            k=k_stack, v=v_stack, pos=jnp.asarray(s, jnp.int32)
-        )
+        cache = KVCache(k=k_stack, v=v_stack, pos=pos)
     return logits, cache
 
 
@@ -111,19 +125,37 @@ def decode_step(model: TransformerLM, token, cache: KVCache):
     """One autoregressive step: (B,) token at position ``cache.pos`` →
     ((B, V) logits, updated cache). Attention reads the full static-shape
     cache with positions ≥ pos masked — compiler-friendly in exchange for
-    O(S_max) work per step."""
+    O(S_max) work per step.
+
+    ``cache.pos`` may be the classic scalar (every row at the same
+    position — one in-place 5-D slice write per buffer, the cheapest
+    path, kept bit-identical) or a **(B,) vector**: each row decodes at
+    its own position, which is what continuous batching needs — slots
+    join and retire independently, so the pool's rows are never aligned.
+    The vector path writes via a one-hot select over the position axis
+    (O(S_max) per layer — the same order as the attention read that
+    follows, so nothing asymptotically new)."""
     cdt = jnp.dtype(model.compute_dtype)
     d = model.embed.shape[-1]
     h = model.num_heads
     hd = d // h
     n = token.shape[0]
     pos = cache.pos
+    s_cap = cache.k.shape[3]
+    vec = getattr(pos, "ndim", 0) >= 1  # per-row positions
     x = _gather_embed(model.embed, token)[:, None] * math.sqrt(d)
     if model.pos_encoding == "learned":
-        x = x + jax.lax.dynamic_slice_in_dim(model.pos_embed, pos, 1)
+        if vec:
+            x = x + jnp.take(model.pos_embed, pos, axis=0)[:, None]
+        else:
+            x = x + jax.lax.dynamic_slice_in_dim(model.pos_embed, pos, 1)
     x = x.astype(cdt)
 
-    valid = (jnp.arange(cache.k.shape[3]) <= pos)[None, None, None, :]
+    if vec:
+        valid = (jnp.arange(s_cap)[None, :] <= pos[:, None])[:, None, None, :]
+        hit = (jnp.arange(s_cap)[None, :] == pos[:, None])[:, None, :, None]
+    else:
+        valid = (jnp.arange(s_cap) <= pos)[None, None, None, :]
     quantized = cache.k_scale is not None
     new_k, new_v = cache.k, cache.v
     new_ks, new_vs = cache.k_scale, cache.v_scale
@@ -131,30 +163,34 @@ def decode_step(model: TransformerLM, token, cache: KVCache):
     kvh = model.kv_heads
     g = h // kvh  # query heads per K/V head (1 = plain MHA)
 
+    def write(buf, i, val):
+        """Write the (B, KV_heads, 1, *) new-position slab into layer
+        ``i`` of a (L, B, KV_heads, S_max, *) buffer at ``pos``."""
+        if not vec:
+            return jax.lax.dynamic_update_slice(
+                buf, val[None].astype(buf.dtype), (i, 0, 0, pos, 0)
+            )
+        layer = jnp.where(hit, val.astype(buf.dtype), buf[i])
+        return jax.lax.dynamic_update_slice(buf, layer[None], (i, 0, 0, 0, 0))
+
     def cached_attn(i):
         def attn(y, blk):
             nonlocal new_k, new_v, new_ks, new_vs
             # the shared split+rope helper, at the new token's global
             # position; cached keys were stored rotated by prefill /
             # earlier steps
-            q, k1, v1 = model._qkv_heads(y, blk, positions=pos[None])
+            q, k1, v1 = model._qkv_heads(
+                y, blk, positions=pos[:, None] if vec else pos[None]
+            )
             if quantized:
                 k1, k1s = _kv_quant(k1)
                 v1, v1s = _kv_quant(v1)
-                new_ks = jax.lax.dynamic_update_slice(
-                    new_ks, k1s[None], (i, 0, 0, pos, 0)
-                )
-                new_vs = jax.lax.dynamic_update_slice(
-                    new_vs, v1s[None], (i, 0, 0, pos, 0)
-                )
+                new_ks = write(new_ks, i, k1s)
+                new_vs = write(new_vs, i, v1s)
             # one 5-D in-place update per buffer — not gather + rewrite,
             # which XLA may lower to an O(L·S_max) cache copy per layer
-            new_k = jax.lax.dynamic_update_slice(
-                new_k, k1[None].astype(new_k.dtype), (i, 0, 0, pos, 0)
-            )
-            new_v = jax.lax.dynamic_update_slice(
-                new_v, v1[None].astype(new_v.dtype), (i, 0, 0, pos, 0)
-            )
+            new_k = write(new_k, i, k1)
+            new_v = write(new_v, i, v1)
             layer_k, layer_v = new_k[i], new_v[i]
             # grouped attention (MHA is the g=1 special case): q heads
             # regroup as (KV, G) against the KV-head cache — no repeated
@@ -196,7 +232,8 @@ def decode_step(model: TransformerLM, token, cache: KVCache):
     # past-capacity poison: at pos >= S_max the cache write would clamp
     # onto S_max-1 and return plausible-but-wrong logits; pos is traced,
     # so the honest device-side failure is loud NaNs, not an exception
-    logits = jnp.where(pos < cache.k.shape[3], logits, jnp.nan)
+    in_cap = (pos < s_cap)[:, None] if vec else pos < s_cap
+    logits = jnp.where(in_cap, logits, jnp.nan)
     return logits, KVCache(
         k=new_k, v=new_v, pos=pos + 1, k_scale=new_ks, v_scale=new_vs
     )
@@ -235,7 +272,9 @@ def _filter_logits(logits, top_k: int, top_p: float):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("max_new", "temperature", "top_k", "top_p", "kv_dtype"),
+    static_argnames=(
+        "max_new", "temperature", "top_k", "top_p", "kv_dtype", "eos_id"
+    ),
 )
 def generate(
     model: TransformerLM,
@@ -247,13 +286,26 @@ def generate(
     top_p: float = 0.0,
     kv_dtype: str | None = None,
     key=None,
+    prompt_lens=None,
+    eos_id: int | None = None,
 ):
     """Greedy (temperature=0) or sampled decode of ``max_new`` tokens after
     ``prompt`` (B, P). One jitted program: prefill + lax.scan over steps.
     ``top_k``/``top_p`` (nucleus) restrict sampling to the head of the
     distribution (0 = off; both compose); ``kv_dtype="int8"`` halves the
     cache stream at long context (see :class:`KVCache`). Returns
-    (B, max_new) int32."""
+    (B, max_new) int32.
+
+    ``prompt_lens`` ((B,) int32) admits unequal-length prompts
+    right-padded to ``P``: each row's first pick comes from its own last
+    real token and decode continues at its own position (per-row cache
+    positions — see :func:`prefill` / :func:`decode_step`). ``eos_id``
+    arms per-sequence early exit: a row that emits EOS is frozen (its
+    remaining output is EOS-filled) and the whole loop stops — still one
+    compiled program, as a ``lax.while_loop`` with a dynamic trip count
+    — as soon as every row has finished, so a batch of short answers
+    never pays ``max_new`` steps. With both arguments left at their
+    defaults the program is the original scan, bit-identical."""
     if key is None:
         key = jax.random.key(0)
     s_max = prompt.shape[1] + max_new
@@ -261,7 +313,11 @@ def generate(
         raise ValueError(
             f"prompt+max_new={s_max} exceeds max_seq={model.pos_embed.shape[0]}"
         )
-    logits0, cache = prefill(model, prompt, s_max, kv_dtype=kv_dtype)
+    if prompt_lens is not None:
+        prompt_lens = jnp.asarray(prompt_lens, jnp.int32)
+    logits0, cache = prefill(
+        model, prompt, s_max, kv_dtype=kv_dtype, lengths=prompt_lens
+    )
 
     def pick(logits, k):
         if temperature == 0.0:
@@ -273,6 +329,33 @@ def generate(
 
     keys = jax.random.split(key, max_new)
     tok0 = pick(logits0, keys[0])
+    if max_new == 1:
+        return tok0[:, None]
+
+    if eos_id is not None:
+        # early-exit decode: a while_loop whose trip count is data-
+        # dependent — per-step keys via fold_in (a scan's pre-split keys
+        # can't be indexed ahead of a dynamic counter as cheaply)
+        out0 = jnp.full(
+            (prompt.shape[0], max_new), eos_id, jnp.int32
+        ).at[:, 0].set(tok0)
+
+        def cond(c):
+            i, _, _, done, _ = c
+            return (i < max_new) & ~jnp.all(done)
+
+        def body(c):
+            i, tok, cache, done, out = c
+            logits, cache2 = decode_step(model, tok, cache)
+            tok2 = pick(logits, jax.random.fold_in(key, i))
+            tok2 = jnp.where(done, eos_id, tok2)
+            out = jax.lax.dynamic_update_slice(out, tok2[:, None], (0, i))
+            return (i + 1, tok2, cache2, done | (tok2 == eos_id), out)
+
+        carry = (
+            jnp.asarray(1, jnp.int32), tok0, cache, tok0 == eos_id, out0
+        )
+        return jax.lax.while_loop(cond, body, carry)[4]
 
     # scan max_new-1 steps: the token for step i is picked from step i-1's
     # logits, so the final logits need no decode step of their own
@@ -282,8 +365,6 @@ def generate(
         tok2 = pick(logits, k)
         return (tok2, cache2), tok2
 
-    if max_new == 1:
-        return tok0[:, None]
     (_, _), rest = jax.lax.scan(step, (tok0, cache), keys[1:])
     return jnp.concatenate([tok0[:, None], rest.T], axis=1)  # (B, max_new)
 

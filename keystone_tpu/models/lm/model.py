@@ -94,13 +94,20 @@ def _split_heads(y, w, h, mm_fn=mm):
 
 def _rope(x, positions, base: float = 10_000.0):
     """Rotary position embedding. x: (..., S, hd), hd even; positions:
-    (S,) int32 global token positions. Angles in f32 (bf16 loses phase
-    accuracy fast at long context), rotated result back in x.dtype."""
+    (S,) int32 global token positions — or (B, S) when sequences in the
+    batch sit at different positions (the serving decode pool: each slot
+    carries its own sequence, so each rotates at its own phase). Angles
+    in f32 (bf16 loses phase accuracy fast at long context), rotated
+    result back in x.dtype."""
     hd = x.shape[-1]
     half = hd // 2
     inv = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
-    freqs = positions.astype(jnp.float32)[:, None] * inv  # (S, half)
+    freqs = positions.astype(jnp.float32)[..., None] * inv  # (..., S, half)
     cos, sin = jnp.cos(freqs), jnp.sin(freqs)
+    if freqs.ndim == 3:
+        # (B, S, half) phases meet (B, H, S, hd/2) halves: insert the
+        # head axis so each batch row broadcasts over its own heads
+        cos, sin = cos[:, None], sin[:, None]
     x1 = x[..., :half].astype(jnp.float32)
     x2 = x[..., half:].astype(jnp.float32)
     return jnp.concatenate(

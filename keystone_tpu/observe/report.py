@@ -46,6 +46,7 @@ def summarize(events: list[dict]) -> dict[str, Any]:
     spans: list[dict] = []
     optimizes: list[dict] = []
     clusters: list[dict] = []
+    serves: list[dict] = []
     device_memory: dict | None = None
     trace_windows: list[dict] = []
     meta: dict[str, Any] = {"run": None, "wall_s": None, "status": None}
@@ -76,6 +77,8 @@ def summarize(events: list[dict]) -> dict[str, Any]:
             optimizes.append(ev)
         elif kind == "cluster":
             clusters.append(ev)
+        elif kind == "serve":
+            serves.append(ev)
         elif kind == "device_memory":
             device_memory = ev  # latest sample carries current watermarks
         elif kind == "trace_window":
@@ -91,6 +94,7 @@ def summarize(events: list[dict]) -> dict[str, Any]:
         "spans": spans,
         "optimizes": optimizes,
         "clusters": clusters,
+        "serves": serves,
         "device_memory": device_memory,
         "trace_windows": trace_windows,
     }
@@ -224,6 +228,16 @@ def render(run_dir: str) -> str:
             )
             lines.append(f"  {ev.get('action', '?')}: {fields}")
         lines.append("")
+    if summary.get("serves"):
+        lines.append("serving (request path lifecycle):")
+        for ev in summary["serves"]:
+            fields = ", ".join(
+                f"{k}={v}"
+                for k, v in ev.items()
+                if k not in ("event", "ts", "run", "phase", "action")
+            )
+            lines.append(f"  {ev.get('action', '?')}: {fields}")
+        lines.append("")
     lines.extend(_telemetry_sections(run_dir, summary))
     if peak is None and profiles:
         lines.append(
@@ -305,6 +319,62 @@ def _telemetry_sections(run_dir: str, summary: dict) -> list[str]:
                 f"{int(rows)} row(s)"
                 + (f", last {rps[-1]:,.0f} rows/s" if rps else "")
             )
+            lines.append("")
+        serve_rows = [r for r in recs if r.get("source") == "serve"]
+        if serve_rows:
+            batches = [r for r in serve_rows if "bucket" in r]
+            decodes = [r for r in serve_rows if r.get("kind") == "decode"]
+            parts = []
+            if batches:
+                rows = sum(
+                    r["rows"]
+                    for r in batches
+                    if isinstance(r.get("rows"), (int, float))
+                )
+                fills = [
+                    r["batch_fill"]
+                    for r in batches
+                    if isinstance(r.get("batch_fill"), (int, float))
+                ]
+                part = f"{len(batches)} batch(es), {int(rows)} row(s)"
+                if fills:
+                    part += f", mean fill {sum(fills) / len(fills):.2f}"
+                parts.append(part)
+            if decodes:
+                toks = sum(
+                    r["tokens"]
+                    for r in decodes
+                    if isinstance(r.get("tokens"), (int, float))
+                )
+                parts.append(
+                    f"{len(decodes)} generation(s), {int(toks)} token(s)"
+                )
+            lines.append("serving stream: " + "; ".join(parts))
+            # two different walls, NOT poolable: batch rows carry the
+            # per-dispatch wall, decode rows the submit-to-finish wall
+            # of a whole generation (orders of magnitude apart)
+            batch_walls = [
+                r["wall_s"]
+                for r in batches
+                if isinstance(r.get("wall_s"), (int, float))
+            ]
+            if batch_walls:
+                p = percentiles(batch_walls, (50, 95))
+                lines.append(
+                    f"  dispatch wall p50 {p[50] * 1e3:.1f} ms  "
+                    f"p95 {p[95] * 1e3:.1f} ms"
+                )
+            gen_walls = [
+                r["wall_s"]
+                for r in decodes
+                if isinstance(r.get("wall_s"), (int, float))
+            ]
+            if gen_walls:
+                p = percentiles(gen_walls, (50, 95))
+                lines.append(
+                    f"  generation wall p50 {p[50] * 1e3:.1f} ms  "
+                    f"p95 {p[95] * 1e3:.1f} ms"
+                )
             lines.append("")
     devmem = summary.get("device_memory")
     if devmem:

@@ -124,6 +124,7 @@ def summarize(steps: list[dict], events: list[dict]) -> dict[str, Any]:
         "plan_decisions": 0,
         "plan_streams": 0,
         "trace_windows": 0,
+        "serve": {},
         "last_ts": None,
     }
     # the stream mixes sources: train steps (source="train") carry the
@@ -139,6 +140,42 @@ def summarize(steps: list[dict], events: list[dict]) -> dict[str, Any]:
     out["plan_streams"] = len(plan_rows)
     if plan_rows:
         out["last_ts"] = plan_rows[-1].get("ts")
+    # serving stream rows: micro-batch dispatches (rows/bucket/batch_fill
+    # per batch) and finished generations (kind="decode", tokens per
+    # request) — the serving panel's live numbers
+    serve_rows = [r for r in steps if r.get("source") == "serve"]
+    if serve_rows:
+        sv: dict[str, Any] = out["serve"]
+        batches = [r for r in serve_rows if "bucket" in r]
+        decodes = [r for r in serve_rows if r.get("kind") == "decode"]
+        if batches:
+            sv["batches"] = len(batches)
+            sv["rows"] = int(
+                sum(
+                    r["rows"]
+                    for r in batches
+                    if isinstance(r.get("rows"), (int, float))
+                )
+            )
+            fills = [
+                r["batch_fill"]
+                for r in batches
+                if isinstance(r.get("batch_fill"), (int, float))
+            ]
+            if fills:
+                sv["batch_fill"] = sum(fills) / len(fills)
+        if decodes:
+            sv["generations"] = len(decodes)
+            sv["tokens"] = int(
+                sum(
+                    r["tokens"]
+                    for r in decodes
+                    if isinstance(r.get("tokens"), (int, float))
+                )
+            )
+        out["last_ts"] = max(
+            out["last_ts"] or 0, serve_rows[-1].get("ts") or 0
+        ) or None
     out["n_steps"] = len(train)
     if train:
         last = train[-1]
@@ -179,6 +216,16 @@ def summarize(steps: list[dict], events: list[dict]) -> dict[str, Any]:
         elif kind == "cluster":
             action = str(ev.get("action", "?"))
             out["cluster"][action] = out["cluster"].get(action, 0) + 1
+        elif kind == "serve":
+            sv = out["serve"]
+            action = str(ev.get("action", "?"))
+            if action == "start":
+                sv["model"] = ev.get("model")
+                sv["port"] = ev.get("port")
+                sv["cold_start_s"] = ev.get("cold_start_s")
+                sv["status"] = "serving"
+            elif action == "stop":
+                sv["status"] = "stopped"
         elif kind == "optimize":
             out["plan_decisions"] += len(ev.get("decisions") or []) or 1
         elif kind == "trace_window":
@@ -253,6 +300,32 @@ def render(state: dict[str, Any], run_dir: str) -> str:
             f"{k}={v}" for k, v in sorted(state["cluster"].items())
         )
         lines.append(f"cluster: {pairs}")
+    sv = state.get("serve") or {}
+    if sv:
+        head = "serving:"
+        if sv.get("model"):
+            head += f" {sv['model']}"
+        if sv.get("port"):
+            head += f" @ :{sv['port']}"
+        if sv.get("status"):
+            head += f"  [{sv['status']}]"
+        if isinstance(sv.get("cold_start_s"), (int, float)):
+            head += f"  cold start {sv['cold_start_s']:.2f}s"
+        lines.append(head)
+        parts = []
+        if sv.get("batches"):
+            parts.append(
+                f"{sv['batches']} batch(es)  {sv.get('rows', 0)} row(s)"
+            )
+            if isinstance(sv.get("batch_fill"), (int, float)):
+                parts.append(f"fill {sv['batch_fill']:.2f}")
+        if sv.get("generations"):
+            parts.append(
+                f"{sv['generations']} generation(s)  "
+                f"{sv.get('tokens', 0)} tok"
+            )
+        if parts:
+            lines.append("  " + "  ".join(parts))
     if state["plan_decisions"] or state.get("plan_streams"):
         parts = []
         if state["plan_decisions"]:

@@ -460,6 +460,47 @@ def apply_shared(
     return [jnp.concatenate(o, axis=0) for o in outs]
 
 
+def serve_stream(
+    dispatch: Callable,
+    rows,
+    bucket: int,
+    *,
+    inflight: int = 2,
+    stage_depth: int | None = None,
+):
+    """The serving path's oversized-batch drain: a request batch larger
+    than the biggest compiled bucket streams through ``dispatch`` in
+    exactly-``bucket``-sized chunks (tail zero-padded, pad rows trimmed
+    — every dispatch hits the same AOT executable) via the shared
+    staging engine, so chunk k+1's host→device transfer overlaps chunk
+    k's compute instead of serializing pad→dispatch→sync round-trips.
+
+    Same contract as :func:`keystone_tpu.core.batching.apply_in_chunks`
+    (which does the work): ``dispatch`` maps a (bucket, ...) batch to a
+    row-indexed array. Emits one ``source="serve"`` stream row when a
+    telemetry sink is active — the serving panel's bulk-request line."""
+    reg = _metrics.get_registry()
+    steplog = _telemetry.active_step_log()
+    t0 = time.perf_counter()
+    out = apply_in_chunks(
+        dispatch, rows, bucket, inflight=inflight, stage_depth=stage_depth
+    )
+    reg.counter("serve_stream_batches").inc()
+    if steplog is not None:
+        wall = time.perf_counter() - t0
+        n = int(rows.shape[0])
+        steplog.record(
+            "serve",
+            rows=n,
+            bucket=bucket,
+            chunks=-(-n // bucket),
+            batch_fill=round(n / (-(-n // bucket) * bucket), 4),
+            wall_s=round(wall, 6),
+            requests=1,
+        )
+    return out
+
+
 def _node_span(name: str, phase: str):
     from keystone_tpu.core.pipeline import _node_span as span
 

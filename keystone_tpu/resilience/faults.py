@@ -71,6 +71,11 @@ SITES: dict[str, str] = {
     "step — a sudden host death: no checkpoint, no cleanup "
     "(models/lm/train.py; key = step index; `supervise` strips this "
     "site on relaunch so the survivor set doesn't replay the kill)",
+    "serve.drop": "shed the keyed request at admission — the serving "
+    "front end answers 503 (serve/server.py; key = request id)",
+    "serve.slow_request": "inject KEYSTONE_SERVE_SLOW_MS of extra "
+    "latency into the keyed request before dispatch — the tail-latency "
+    "drill (serve/server.py; key = request id)",
 }
 
 

@@ -1,0 +1,356 @@
+"""SLO-aware micro-batching: the serving request queue.
+
+A TPU serves batches; users send rows. The micro-batcher is the
+adapter: requests coalesce until either a compiled batch bucket fills
+or the *oldest* request's latency deadline arrives — whichever is
+first — then dispatch as ONE padded program invocation and fan results
+back out, pad rows trimmed. The deadline is the SLO contract: the
+batcher itself never holds a request longer than
+``KEYSTONE_SERVE_DEADLINE_MS`` (the injected-clock tests pin this).
+
+Design rules carried over from the rest of the framework:
+
+- **Injectable clock** (``resilience/retry.py`` discipline): the
+  scheduler is a pure function of (pending set, now); tests drive
+  :meth:`MicroBatcher.pump` with a fake clock and never sleep.
+- **Observable decisions**: every dispatch records ``serve_*`` counters
+  and gauges, a ``serve_request_seconds`` Timer observation per request
+  (reservoir percentiles for the dashboard), and a ``source="serve"``
+  row in the live telemetry stream when a sink is active — ONE global
+  read when observability is off.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from typing import Any, Callable, Sequence
+
+import jax
+import numpy as np
+
+from keystone_tpu.observe import metrics as _metrics
+from keystone_tpu.observe import telemetry as _telemetry
+
+ENV_DEADLINE_MS = "KEYSTONE_SERVE_DEADLINE_MS"
+ENV_BUCKETS = "KEYSTONE_SERVE_BUCKETS"
+
+#: Default coalescing deadline: long enough to fill a bucket under real
+#: traffic, short enough to stay invisible next to dispatch time.
+DEFAULT_DEADLINE_MS = 25.0
+DEFAULT_BUCKETS = (1, 8, 32)
+
+
+def deadline_ms_from_env() -> float:
+    raw = os.environ.get(ENV_DEADLINE_MS, "").strip()
+    if raw:
+        try:
+            val = float(raw)
+            if val >= 0:
+                return val
+        except ValueError:
+            pass
+    return DEFAULT_DEADLINE_MS
+
+
+def buckets_from_env() -> tuple[int, ...]:
+    raw = os.environ.get(ENV_BUCKETS, "").strip()
+    if raw:
+        try:
+            vals = sorted({int(v) for v in raw.split(",") if v.strip()})
+            if vals and all(v > 0 for v in vals):
+                return tuple(vals)
+        except ValueError:
+            pass
+    return DEFAULT_BUCKETS
+
+
+class RequestShed(RuntimeError):
+    """The request was dropped at admission (overload shed — the
+    ``serve.drop`` fault site drills this path deterministically)."""
+
+
+class ServeFuture:
+    """Completion handle for one submitted request (threading.Event
+    based — the stdlib server's handler threads block on it)."""
+
+    def __init__(self) -> None:
+        self._done = threading.Event()
+        self._result: Any = None
+        self._exc: BaseException | None = None
+
+    def set_result(self, value: Any) -> None:
+        self._result = value
+        self._done.set()
+
+    def set_exception(self, exc: BaseException) -> None:
+        self._exc = exc
+        self._done.set()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: float | None = None) -> Any:
+        if not self._done.wait(timeout):
+            raise TimeoutError("serve request still pending")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+
+@dataclasses.dataclass
+class _Pending:
+    rows: Any  # (n, ...) host array — one request
+    n: int
+    enqueued: float  # clock() at submit
+    future: ServeFuture
+    rid: Any = None
+
+
+class MicroBatcher:
+    """Coalesce row-requests into bucket-padded batches under a latency
+    deadline.
+
+    ``dispatch(batch) -> outputs`` runs the model on a (bucket, ...)
+    batch and returns row-indexed outputs (array or pytree of arrays —
+    leading axis is rows). ``buckets`` are the compiled batch sizes
+    (sorted ascending); a coalesced batch pads up to the smallest
+    bucket that holds it, and a single request larger than the biggest
+    bucket dispatches alone immediately (the exported apply chunks it).
+
+    ``start=False`` gives the scheduler-only form for tests and
+    single-threaded drivers: call :meth:`pump` with an explicit ``now``
+    to execute exactly the dispatches that are due. With ``start=True``
+    a daemon thread runs the same logic against the (injectable)
+    ``clock``, sleeping precisely until the next deadline.
+    """
+
+    def __init__(
+        self,
+        dispatch: Callable[[Any], Any],
+        *,
+        buckets: Sequence[int] | None = None,
+        deadline_ms: float | None = None,
+        clock: Callable[[], float] = time.monotonic,
+        start: bool = True,
+    ):
+        self.dispatch = dispatch
+        self.buckets = tuple(
+            sorted(buckets) if buckets else buckets_from_env()
+        )
+        if not self.buckets or any(b <= 0 for b in self.buckets):
+            raise ValueError(f"buckets={self.buckets}: need positive sizes")
+        self.deadline_s = (
+            deadline_ms_from_env() if deadline_ms is None else deadline_ms
+        ) / 1e3
+        self.clock = clock
+        self._pending: list[_Pending] = []
+        self._cond = threading.Condition()
+        self._stop = False
+        self._thread: threading.Thread | None = None
+        if start:
+            self._thread = threading.Thread(
+                target=self._loop, name="serve-microbatch", daemon=True
+            )
+            self._thread.start()
+
+    # ------------------------------------------------------------ submit
+
+    def submit(self, rows: Any, rid: Any = None) -> ServeFuture:
+        """Queue one request of ``rows`` ((n, ...) — n >= 1) and return
+        its future. Thread-safe."""
+        rows = np.asarray(rows)
+        if rows.ndim < 1 or rows.shape[0] < 1:
+            raise ValueError(f"request rows shape {rows.shape}: need (n, ...)")
+        fut = ServeFuture()
+        reg = _metrics.get_registry()
+        with self._cond:
+            if self._stop:
+                fut.set_exception(RequestShed("server shutting down"))
+                return fut
+            self._pending.append(
+                _Pending(
+                    rows=rows,
+                    n=int(rows.shape[0]),
+                    enqueued=self.clock(),
+                    future=fut,
+                    rid=rid,
+                )
+            )
+            reg.counter("serve_requests").inc()
+            reg.counter("serve_rows").inc(int(rows.shape[0]))
+            reg.gauge("serve_queue_depth").set(float(len(self._pending)))
+            self._cond.notify()
+        return fut
+
+    # --------------------------------------------------------- scheduling
+
+    def _bucket_for(self, rows: int) -> int:
+        for b in self.buckets:
+            if rows <= b:
+                return b
+        return self.buckets[-1]
+
+    def _due(self, now: float) -> bool:
+        """Must something dispatch at ``now``? (caller holds the lock)"""
+        if not self._pending:
+            return False
+        total = sum(p.n for p in self._pending)
+        if total >= self.buckets[-1]:
+            return True  # a full bucket never waits
+        oldest = min(p.enqueued for p in self._pending)
+        return now - oldest >= self.deadline_s
+
+    def _next_deadline(self) -> float | None:
+        """Absolute clock time of the oldest pending request's deadline
+        (caller holds the lock)."""
+        if not self._pending:
+            return None
+        return min(p.enqueued for p in self._pending) + self.deadline_s
+
+    def _take(self) -> list[_Pending]:
+        """Pop the batch to dispatch (caller holds the lock): FIFO
+        requests up to the largest bucket, never splitting a request —
+        except a request alone bigger than every bucket, which ships
+        solo (the exported apply chunks oversized batches)."""
+        cap = self.buckets[-1]
+        take: list[_Pending] = []
+        total = 0
+        for p in list(self._pending):
+            if take and total + p.n > cap:
+                break
+            take.append(p)
+            total += p.n
+            if total >= cap:
+                break
+        for p in take:
+            self._pending.remove(p)
+        return take
+
+    # ----------------------------------------------------------- dispatch
+
+    def _run_batch(self, batch: list[_Pending]) -> None:
+        """Pad, dispatch, split, resolve — outside the lock. EVERYTHING
+        from coalesce to dispatch sits inside the error fan-out: a bad
+        request (e.g. a row shape that won't concatenate with its batch
+        mates) must fail ITS futures, never kill the batching thread —
+        a dead thread would hang every pending and future request while
+        /healthz still answered ok."""
+        reg = _metrics.get_registry()
+        t0 = time.perf_counter()
+        try:
+            rows = np.concatenate([p.rows for p in batch], axis=0)
+            n = rows.shape[0]
+            bucket = self._bucket_for(n)
+            padded = rows
+            if n < bucket:
+                pad = np.zeros((bucket - n, *rows.shape[1:]), rows.dtype)
+                padded = np.concatenate([rows, pad], axis=0)
+            out = self.dispatch(padded)
+        except BaseException as e:  # noqa: BLE001 — fan the failure out
+            for p in batch:
+                p.future.set_exception(e)
+            reg.counter("serve_dispatch_errors").inc()
+            return
+        wall = time.perf_counter() - t0
+        off = 0
+        now = self.clock()
+        for p in batch:
+            sl = p.future
+            res = jax.tree_util.tree_map(
+                lambda a, o=off, m=p.n: a[o : o + m], out
+            )
+            off += p.n
+            reg.timer("serve_request_seconds").observe(
+                max(now - p.enqueued, 0.0)
+            )
+            sl.set_result(res)
+        reg.counter("serve_batches").inc()
+        reg.counter("serve_pad_rows").inc(max(bucket - n, 0))
+        fill = n / bucket if bucket else 0.0
+        reg.gauge("serve_batch_fill").set(fill)
+        with self._cond:
+            reg.gauge("serve_queue_depth").set(float(len(self._pending)))
+        steplog = _telemetry.active_step_log()
+        if steplog is not None:
+            steplog.record(
+                "serve",
+                rows=n,
+                bucket=bucket,
+                batch_fill=round(fill, 4),
+                wall_s=round(wall, 6),
+                requests=len(batch),
+            )
+
+    def pump(self, now: float | None = None) -> int:
+        """Execute every dispatch due at ``now`` (default: the clock) and
+        return how many batches ran. The single-threaded drive used by
+        the injected-clock tests; the background thread calls the same
+        logic."""
+        ran = 0
+        while True:
+            t = self.clock() if now is None else now
+            with self._cond:
+                if not self._due(t):
+                    return ran
+                batch = self._take()
+            if not batch:
+                return ran
+            self._run_batch(batch)
+            ran += 1
+
+    def wait_s(self, now: float | None = None) -> float | None:
+        """Seconds until the next deadline-forced dispatch (None = no
+        pending work). Tests assert the batcher never plans to sleep
+        past an SLO."""
+        with self._cond:
+            nd = self._next_deadline()
+        if nd is None:
+            return None
+        t = self.clock() if now is None else now
+        return max(nd - t, 0.0)
+
+    # ------------------------------------------------------------- thread
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                if self._stop and not self._pending:
+                    return
+                nd = self._next_deadline()
+                if not self._pending:
+                    self._cond.wait(timeout=0.5)
+                    continue
+                if not self._due(self.clock()):
+                    # sleep exactly to the oldest deadline; a new submit
+                    # notifies and may fill a bucket sooner
+                    self._cond.wait(
+                        timeout=max(nd - self.clock(), 0.0) if nd else 0.1
+                    )
+            self.pump()
+
+    def close(self, drain: bool = True, timeout: float = 10.0) -> None:
+        """Stop accepting requests; ``drain=True`` dispatches what is
+        already queued (the SIGTERM path — in-flight work completes,
+        new work is shed)."""
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        if drain:
+            while True:
+                with self._cond:
+                    if not self._pending:
+                        break
+                    batch = self._take()
+                if batch:
+                    self._run_batch(batch)
+        else:
+            with self._cond:
+                orphans, self._pending = self._pending, []
+            for p in orphans:
+                p.future.set_exception(RequestShed("server shutting down"))
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None

@@ -1,0 +1,491 @@
+"""The serving front end: stdlib HTTP/JSON over the exported apply.
+
+``python -m keystone_tpu serve <model> [--port N]`` where ``<model>``
+is:
+
+- a ``save_fitted`` checkpoint path — load (spec-verified), AOT-export,
+  serve ``POST /predict``,
+- ``mnist`` — fit the small synthetic MNIST random-FFT pipeline in
+  process and serve it (the smoke/demo path; no data files needed),
+- ``lm`` — a small transformer LM served through the
+  continuous-batching decode pool (``POST /generate``).
+
+Endpoints::
+
+    POST /predict  {"rows": [[...], ...]}        -> {"predictions": [...]}
+    POST /generate {"prompt": [...], "max_new"}  -> {"tokens": [...]}
+    GET  /healthz                                -> status + latency summary
+    GET  /metrics                                -> metrics registry snapshot
+
+Wiring (the point of serving *this* framework):
+
+- requests coalesce in the :mod:`.queue` micro-batcher under
+  ``KEYSTONE_SERVE_DEADLINE_MS`` and dispatch through the AOT bucket
+  executables,
+- every request is keyed (a process-monotone id) through the
+  ``serve.drop`` / ``serve.slow_request`` fault sites, so overload-shed
+  and tail-latency behavior replay deterministically like every other
+  subsystem,
+- a request-path :class:`~keystone_tpu.resilience.watchdog.Watchdog`
+  flags a wedged dispatch (in-flight work but no completions) with
+  thread stacks,
+- per-request latency lands in the ``serve_request_seconds`` /
+  ``serve_http_seconds`` Timer reservoirs (p50/p95/p99 in ``/healthz``
+  and the ``observe top`` serving panel), queue depth and batch fill in
+  gauges, and lifecycle in ``serve`` events when an observe sink is
+  active,
+- SIGTERM drains: stop accepting, finish what is queued, exit 0 — the
+  shutdown contract ``supervise`` relies on.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from keystone_tpu.core.logging import get_logger
+from keystone_tpu.observe import events as _events
+from keystone_tpu.observe import metrics as _metrics
+from keystone_tpu.resilience import faults as _faults
+
+logger = get_logger("keystone_tpu.serve.server")
+
+ENV_SLOW_MS = "KEYSTONE_SERVE_SLOW_MS"
+ENV_TIMEOUT_S = "KEYSTONE_SERVE_TIMEOUT_S"
+
+
+def _request_timeout_s() -> float:
+    try:
+        return float(os.environ.get(ENV_TIMEOUT_S, "") or 30.0)
+    except ValueError:
+        return 30.0
+
+
+def _slow_s() -> float:
+    try:
+        return float(os.environ.get(ENV_SLOW_MS, "") or 100.0) / 1e3
+    except ValueError:
+        return 0.1
+
+
+class ServeApp:
+    """Everything behind the HTTP surface: the exported model, the
+    micro-batcher / decode pool, fault-site admission, the request-path
+    watchdog, and drain-on-shutdown."""
+
+    def __init__(
+        self,
+        *,
+        exported=None,
+        decode_loop=None,
+        deadline_ms: float | None = None,
+        watchdog_timeout_s: float = 60.0,
+    ):
+        if exported is None and decode_loop is None:
+            raise ValueError("need an exported pipeline and/or a decode loop")
+        self.exported = exported
+        self.loop = decode_loop
+        self._rid = itertools.count()
+        self._inflight = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.batcher = None
+        if exported is not None:
+            from keystone_tpu.serve.queue import MicroBatcher
+
+            self.batcher = MicroBatcher(
+                exported,
+                buckets=exported.buckets,
+                deadline_ms=deadline_ms,
+            )
+        self._decode_thread = None
+        if decode_loop is not None:
+            self._decode_thread = threading.Thread(
+                target=decode_loop.worker,
+                args=(self._stop,),
+                name="serve-decode",
+                daemon=True,
+            )
+            self._decode_thread.start()
+        # request-path stall detection: in-flight work with no
+        # completions for watchdog_timeout_s dumps stacks (log-only —
+        # shedding/aborting is the operator's call, not the dog's)
+        from keystone_tpu.resilience.watchdog import Watchdog
+
+        self._dog = Watchdog(
+            timeout_s=watchdog_timeout_s, label="serve_dispatch"
+        ).start()
+        self._pet_thread = threading.Thread(
+            target=self._pet_when_idle, name="serve-watchdog-pet", daemon=True
+        )
+        self._pet_thread.start()
+
+    # --------------------------------------------------------- admission
+
+    def admit(self) -> int:
+        """Assign the request id and run the fault sites: a ``serve.drop``
+        hit sheds the request (the caller 503s), a ``serve.slow_request``
+        hit injects tail latency — both keyed by the id, so a drill
+        replays exactly."""
+        rid = next(self._rid)
+        if _faults.fire("serve.drop", rid):
+            _metrics.get_registry().counter("serve_shed").inc()
+            raise OverloadShed(f"request {rid} shed (serve.drop)")
+        if _faults.fire("serve.slow_request", rid):
+            _metrics.get_registry().counter("serve_slowed").inc()
+            time.sleep(_slow_s())
+        return rid
+
+    def _pet_when_idle(self) -> None:
+        while not self._stop.wait(self._dog.poll_s):
+            with self._lock:
+                idle = self._inflight == 0
+            if idle:
+                self._dog.pet()
+        self._dog.stop()
+
+    def _bracket(self):
+        app = self
+
+        class _B:
+            def __enter__(self):
+                with app._lock:
+                    app._inflight += 1
+                return self
+
+            def __exit__(self, *exc):
+                with app._lock:
+                    app._inflight -= 1
+                app._dog.pet()
+                return False
+
+        return _B()
+
+    # ----------------------------------------------------------- request
+
+    def predict(self, rows) -> np.ndarray:
+        if self.batcher is None:
+            raise ValueError("no pipeline exported on this server")
+        rid = self.admit()
+        with self._bracket():
+            fut = self.batcher.submit(rows, rid=rid)
+            return np.asarray(fut.result(timeout=_request_timeout_s()))
+
+    def generate(self, prompt, max_new: int | None = None) -> np.ndarray:
+        if self.loop is None:
+            raise ValueError("no LM decode pool on this server")
+        rid = self.admit()
+        with self._bracket():
+            fut = self.loop.submit(prompt, max_new=max_new, rid=rid)
+            return np.asarray(fut.result(timeout=_request_timeout_s()))
+
+    def health(self) -> dict:
+        reg = _metrics.get_registry()
+        snap = reg.snapshot()
+        t = snap.get("serve_request_seconds") or {}
+        th = snap.get("serve_http_seconds") or {}
+        out = {
+            "status": "draining" if self._stop.is_set() else "ok",
+            "requests": snap.get("serve_requests", 0)
+            + snap.get("serve_decode_requests", 0),
+            "batches": snap.get("serve_batches", 0),
+            "shed": snap.get("serve_shed", 0),
+            "queue_depth": snap.get("serve_queue_depth", 0.0),
+            "batch_fill": snap.get("serve_batch_fill", 0.0),
+            "slots_active": snap.get("serve_slots_active", 0.0),
+        }
+        for name, summ in (("queue", t), ("http", th)):
+            if summ.get("count"):
+                out[f"{name}_p50_ms"] = round(summ.get("p50_s", 0.0) * 1e3, 3)
+                out[f"{name}_p95_ms"] = round(summ.get("p95_s", 0.0) * 1e3, 3)
+        return out
+
+    def shutdown(self) -> None:
+        """Drain: no new work, finish queued work, stop the threads."""
+        self._stop.set()
+        if self.batcher is not None:
+            self.batcher.close(drain=True)
+        if self._decode_thread is not None:
+            self._decode_thread.join(timeout=_request_timeout_s())
+        log = _events.active()
+        if log is not None:
+            log.emit("serve", action="stop")
+
+
+class OverloadShed(RuntimeError):
+    """Admission refused this request (the 503 path)."""
+
+
+def _handler_for(app: ServeApp):
+    class Handler(BaseHTTPRequestHandler):
+        # suppress the default per-request stderr lines; metrics and the
+        # event log are the record
+        def log_message(self, fmt, *args):  # noqa: D102
+            pass
+
+        def _send(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 — stdlib API
+            if self.path == "/healthz":
+                return self._send(200, app.health())
+            if self.path == "/metrics":
+                return self._send(
+                    200, {"metrics": _metrics.get_registry().snapshot()}
+                )
+            return self._send(
+                404,
+                {
+                    "error": f"unknown path {self.path}",
+                    "paths": ["/predict", "/generate", "/healthz", "/metrics"],
+                },
+            )
+
+        def do_POST(self):  # noqa: N802 — stdlib API
+            t0 = time.perf_counter()
+            try:
+                n = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(n) or b"{}")
+            except ValueError:
+                return self._send(400, {"error": "invalid JSON body"})
+            try:
+                if self.path == "/predict":
+                    rows = np.asarray(body.get("rows"), np.float32)
+                    out = app.predict(rows)
+                    payload = {"predictions": out.tolist()}
+                elif self.path == "/generate":
+                    prompt = body.get("prompt")
+                    out = app.generate(
+                        prompt, max_new=body.get("max_new")
+                    )
+                    payload = {"tokens": out.tolist()}
+                else:
+                    return self._send(404, {"error": f"unknown path {self.path}"})
+            except OverloadShed as e:
+                return self._send(503, {"error": str(e)})
+            except (ValueError, TypeError) as e:
+                return self._send(400, {"error": str(e)})
+            except TimeoutError as e:
+                return self._send(504, {"error": str(e)})
+            except Exception as e:  # noqa: BLE001 — the server must answer
+                logger.warning("request failed: %r", e)
+                return self._send(500, {"error": repr(e)})
+            wall = time.perf_counter() - t0
+            _metrics.get_registry().timer("serve_http_seconds").observe(wall)
+            payload["ms"] = round(wall * 1e3, 3)
+            self._send(200, payload)
+
+    return Handler
+
+
+# ------------------------------------------------------------------ models
+
+
+def _fit_mnist_demo(n: int, num_ffts: int = 16):
+    """Fit the MNIST random-FFT pipeline on synthetic data — the
+    in-process demo/smoke model (same construction as the real
+    workload, scaled down)."""
+    import jax
+
+    from keystone_tpu.models.mnist_random_fft import (
+        FeaturizerBank,
+        IMAGE_SIZE,
+        NUM_CLASSES,
+        build_batch_featurizers,
+        featurize,
+    )
+    from keystone_tpu.ops.linear import BlockLeastSquaresEstimator
+    from keystone_tpu.ops.util import ClassLabelIndicators, MaxClassifier
+
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, NUM_CLASSES, size=n).astype(np.int32)
+    centers = (
+        np.random.default_rng(42)
+        .normal(size=(NUM_CLASSES, IMAGE_SIZE))
+        .astype(np.float32)
+    )
+    data = centers[labels] + rng.normal(size=(n, IMAGE_SIZE)).astype(
+        np.float32
+    )
+    groups = build_batch_featurizers(num_ffts, 2048, seed=0)
+    blocks = featurize(groups, data)
+    est = BlockLeastSquaresEstimator(block_size=2048, num_iter=1)
+    model = est.fit(
+        blocks, ClassLabelIndicators(num_classes=NUM_CLASSES)(labels)
+    )
+    bank = FeaturizerBank(batches=tuple(tuple(g) for g in groups))
+    from keystone_tpu.core.pipeline import Pipeline
+
+    pipe = Pipeline.of(bank, model, MaxClassifier())
+    jax.block_until_ready(pipe(data[:1]))
+    return pipe, data[:1]
+
+
+def _build_lm(args: dict):
+    import jax
+
+    from keystone_tpu.models.lm.model import TransformerLM
+
+    return TransformerLM.create(
+        jax.random.key(int(args.get("seed", 0))),
+        vocab=int(args.get("vocab", 256)),
+        max_seq=int(args.get("s_max", 256)),
+        dim=int(args.get("dim", 64)),
+        depth=int(args.get("depth", 2)),
+        num_heads=int(args.get("heads", 4)),
+    )
+
+
+# --------------------------------------------------------------------- CLI
+
+
+USAGE = """usage: python -m keystone_tpu serve <model> [options]
+<model>: a save_fitted checkpoint path | mnist | lm
+options:
+  --port N          listen port (default 8100; 0 = OS-assigned, printed)
+  --host H          bind address (default 127.0.0.1)
+  --buckets A,B,..  compiled batch buckets (default KEYSTONE_SERVE_BUCKETS)
+  --deadline-ms F   micro-batch SLO deadline (default KEYSTONE_SERVE_DEADLINE_MS)
+  --synthetic N     mnist demo fit size (default 2048)
+  --slots N         lm decode slots (default 8)
+  --max-new N       lm default tokens per request (default 64)
+  --s-max N         lm pool sequence capacity (default 256)
+  --quantize        lm weight-only int8
+  --int8-kv         lm int8 KV cache
+  --dim/--depth/--heads/--vocab/--seed  lm demo model shape
+  --input-dim D     row width when serving a checkpoint with no sample meta
+"""
+
+
+def _parse(argv: list[str]) -> tuple[str, dict]:
+    if not argv or argv[0] in ("-h", "--help"):
+        raise SystemExit(USAGE)
+    target, args, i = argv[0], {}, 1
+    flags = {"--quantize": "quantize", "--int8-kv": "int8_kv"}
+    valued = {
+        "--port": "port", "--host": "host", "--buckets": "buckets",
+        "--deadline-ms": "deadline_ms", "--synthetic": "synthetic",
+        "--slots": "slots", "--max-new": "max_new", "--s-max": "s_max",
+        "--dim": "dim", "--depth": "depth", "--heads": "heads",
+        "--vocab": "vocab", "--seed": "seed", "--input-dim": "input_dim",
+    }
+    while i < len(argv):
+        a = argv[i]
+        if a in flags:
+            args[flags[a]] = True
+            i += 1
+        elif a in valued:
+            if i + 1 >= len(argv):
+                raise SystemExit(f"{a} needs a value")
+            args[valued[a]] = argv[i + 1]
+            i += 2
+        else:
+            raise SystemExit(f"unknown option {a!r}\n{USAGE}")
+    return target, args
+
+
+def build_app(target: str, args: dict) -> ServeApp:
+    from keystone_tpu.serve.export import export_lm, export_pipeline
+
+    deadline = (
+        float(args["deadline_ms"]) if "deadline_ms" in args else None
+    )
+    buckets = None
+    if "buckets" in args:
+        buckets = tuple(
+            sorted(int(b) for b in str(args["buckets"]).split(",") if b)
+        )
+    if target in ("mnist", "mnist-random-fft"):
+        pipe, sample = _fit_mnist_demo(int(args.get("synthetic", 2048)))
+        exported = export_pipeline(pipe, sample, buckets=buckets)
+        return ServeApp(exported=exported, deadline_ms=deadline)
+    if target == "lm":
+        model = _build_lm(args)
+        loop = export_lm(
+            model,
+            slots=int(args.get("slots", 8)),
+            s_max=int(args.get("s_max", 256)),
+            quantize=bool(args.get("quantize")),
+            int8_kv=bool(args.get("int8_kv")),
+            max_new=int(args.get("max_new", 64)),
+        )
+        return ServeApp(decode_loop=loop, deadline_ms=deadline)
+    if os.path.exists(target):
+        from keystone_tpu.core.serialization import load_fitted
+
+        pipe, meta = load_fitted(target, with_meta=True)
+        sample = meta.get("sample")
+        if sample is None:
+            if "input_dim" not in args:
+                raise SystemExit(
+                    f"{target} carries no sample meta; pass --input-dim D"
+                )
+            sample = np.zeros((1, int(args["input_dim"])), np.float32)
+        exported = export_pipeline(pipe, np.asarray(sample), buckets=buckets)
+        return ServeApp(exported=exported, deadline_ms=deadline)
+    raise SystemExit(
+        f"unknown model {target!r}: not a checkpoint path, 'mnist', or 'lm'"
+    )
+
+
+def main(argv: list[str] | None = None) -> None:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    target, args = _parse(argv)
+    from keystone_tpu.core.runtime import enable_compilation_cache
+
+    enable_compilation_cache()
+    t0 = time.perf_counter()
+    app = build_app(target, args)
+    cold = time.perf_counter() - t0
+    host = str(args.get("host", "127.0.0.1"))
+    port = int(args.get("port", 8100))
+    httpd = ThreadingHTTPServer((host, port), _handler_for(app))
+    port = httpd.server_address[1]
+
+    log = _events.active()
+    if log is not None:
+        log.emit(
+            "serve", action="start", model=target, port=port,
+            cold_start_s=round(cold, 3),
+        )
+
+    def _term(signum, frame):
+        # drain from a helper thread: shutdown() must not run on the
+        # serve_forever thread (it joins that loop)
+        logger.info("signal %d: draining and shutting down", signum)
+
+        def stop():
+            app.shutdown()
+            httpd.shutdown()
+
+        threading.Thread(target=stop, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    print(
+        f"serving {target!r} on http://{host}:{port} "
+        f"(cold start {cold:.2f}s)",
+        flush=True,
+    )
+    try:
+        httpd.serve_forever(poll_interval=0.1)
+    finally:
+        httpd.server_close()
+    logger.info("server stopped cleanly")
+
+
+if __name__ == "__main__":
+    main()
