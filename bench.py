@@ -873,6 +873,62 @@ def bench_lm_step_telemetry() -> dict:
     return out
 
 
+def bench_goodput() -> dict:
+    """Where-the-time-went record from the span stream (observe/spans.py):
+    goodput bucket shares + critical-path length for (a) the planned
+    mnist demo apply streaming chunks through the staging engine and
+    (b) a tiny LM train loop — so BENCH_*.json carries the stall/compute
+    split the self-tuning planner will consume, not just headline rates.
+    Deliberately small — runs on the CPU fallback too."""
+    import jax
+
+    from keystone_tpu import plan as plan_mod
+    from keystone_tpu.models import lm_transformer as lm
+    from keystone_tpu.observe import events as observe_events
+    from keystone_tpu.observe import spans as observe_spans
+    from keystone_tpu.serve.server import _fit_mnist_demo
+
+    def summarize() -> dict:
+        sl = observe_spans.active_span_log()
+        recs = list(sl.records) if sl is not None else []
+        g = observe_spans.goodput_summary(recs)
+        return {
+            "buckets": {
+                b: row["share"] for b, row in g["buckets"].items()
+            },
+            "classified_s": g["total_s"],
+            "critical_path_s": g["critical_path_s"],
+            "spans": g["spans"],
+        }
+
+    out: dict = {}
+    rng = np.random.default_rng(0)
+    pipe, sample = _fit_mnist_demo(512, num_ffts=4)
+    rows = rng.normal(size=(2048, sample.shape[1])).astype(np.float32)
+    plan = plan_mod.plan_pipeline(
+        pipe, sample=rows[:256], n_rows=rows.shape[0]
+    )
+    if not plan.chunk_size:
+        # the probe workload is small enough that the planner may choose
+        # an unchunked pass — force a chunked stream so the record shows
+        # the staging engine's h2d/wait split, which is its point
+        plan.chunk_size = 512
+    jax.block_until_ready(plan_mod.run_plan(plan, rows))  # warm executables
+    with observe_events.run(workload="goodput_mnist_planned"):
+        jax.block_until_ready(plan_mod.run_plan(plan, rows))
+        out["mnist_planned"] = summarize()
+
+    corpus = lm.synthetic_corpus(4096, 256, seed=0)
+    model = lm.TransformerLM.create(
+        jax.random.key(0), vocab=256, max_seq=64, dim=64, depth=2,
+        num_heads=4,
+    )
+    with observe_events.run(workload="goodput_lm_train"):
+        lm.train(model, corpus, steps=8, batch=8, seq=64, lr=1e-3)
+        out["lm_train"] = summarize()
+    return out
+
+
 def bench_serve_latency(
     n_requests: int = 48,
     fit_n: int = 512,
@@ -1302,6 +1358,13 @@ def main() -> None:
         result["serve_latency"] = {
             "error": f"{type(e).__name__}: {str(e)[:200]}"
         }
+    # goodput breakdown (observe/spans.py): bucket shares + critical
+    # path for the planned mnist run and the LM loop — the stall signal
+    # record, runs on the CPU fallback too
+    try:
+        result["goodput"] = bench_goodput()
+    except Exception as e:  # noqa: BLE001 — same contract as above
+        result["goodput"] = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
     # per-node operator breakdown (observe subsystem): wall time per
     # pipeline node plus compiler-modeled FLOPs/bytes when available
     result["mnist_per_node"] = mnist.get("per_node", {})

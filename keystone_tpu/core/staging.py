@@ -36,6 +36,7 @@ from __future__ import annotations
 import os
 import queue as _queue
 import threading
+import time as _time
 from collections import deque
 from typing import Any, Callable, Iterable, Iterator
 
@@ -134,20 +135,40 @@ def stage_chunks(
     the consumer thread — the fully synchronous reference behavior.
     """
     from keystone_tpu.observe import metrics as _metrics
+    from keystone_tpu.observe import spans as _spans
 
     depth = default_stage_depth() if depth is None else max(int(depth), 0)
     reg = _metrics.get_registry()
     sharded = sharding is not None
     _emit_staging_event(depth=depth, sharded=sharded)
+    # span propagation across the staging thread: the consumer's ambient
+    # context is captured HERE (stream creation) because contextvars do
+    # not flow into the worker — every h2d span parents on it explicitly
+    span_log = _spans.active_span_log()
+    parent_ctx = _spans.current() if span_log is not None else None
 
     def place(chunk: Any, valid: int) -> tuple[Any, bool]:
         spec = sharding(chunk) if callable(sharding) else sharding
+        t0 = _time.perf_counter()
         staged = (
             jax.device_put(chunk, spec)
             if spec is not None
             else jax.device_put(chunk)
         )
         owned = staged is not chunk
+        if owned and span_log is not None:
+            # only real transfers become spans (same rule as the
+            # counters below); with depth > 0 they run on the staging
+            # thread, overlapped with the consumer's compute — the
+            # goodput report prices bytes moved, not consumer stall
+            span_log.record_span(
+                "staging.h2d",
+                wall_s=_time.perf_counter() - t0,
+                bucket="wait_host",
+                parent=parent_ctx,
+                bytes=_nbytes(chunk),
+                sharded=sharded,
+            )
         if owned:
             # only placements that actually created a buffer count as
             # transfers — device_put of an already-resident array moves
@@ -255,11 +276,18 @@ def run_staged(
     engine itself created are freed, and buffer-aliasing passthrough
     outputs are detected and kept.
     """
+    from keystone_tpu.observe import spans as _spans
+
     staged_iter = stage_chunks(chunks, sharding=sharding, depth=stage_depth)
     pending: deque = deque()  # (staged, un-forced result, valid, owned)
+    # force() runs on the consumer thread inside its context — the
+    # device-wait spans parent naturally; looked up once per stream
+    span_log = _spans.active_span_log()
+    wait_parent = _spans.current() if span_log is not None else None
 
     def force(item: tuple[Any, Any, int, bool]) -> Any:
         staged, out, valid, owned = item
+        t0 = _time.perf_counter()
         if to_host:
             forced = jax.tree_util.tree_map(
                 lambda a: np.asarray(a)[:valid], out
@@ -267,6 +295,16 @@ def run_staged(
         else:
             out = jax.block_until_ready(out)
             forced = jax.tree_util.tree_map(lambda a: a[:valid], out)
+        if span_log is not None:
+            # the stall signal the self-tuning planner wants: how long
+            # the host actually blocked on the device for this chunk
+            span_log.record_span(
+                "staging.wait_device",
+                wall_s=_time.perf_counter() - t0,
+                bucket="wait_device",
+                parent=wait_parent,
+                rows=valid,
+            )
         if free_inputs and owned:
             free_buffers(staged, keep=(out, forced))
         return forced

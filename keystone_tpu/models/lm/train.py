@@ -329,6 +329,7 @@ def train(
     import jax.numpy as jnp
 
     from keystone_tpu.observe import devices as _observe_devices
+    from keystone_tpu.observe import spans as _spans
     from keystone_tpu.observe import telemetry as _telemetry
     from keystone_tpu.observe import tracing as _tracing
     from keystone_tpu.parallel.mesh import data_sharding
@@ -539,9 +540,17 @@ def train(
     completed = last_saved = 0
     halted = False
     cluster_lost = False
+    # one trace for the whole training run: every step/checkpoint span
+    # shares it, so `observe trace` renders the loop as one causal unit
+    import uuid as _uuid
+
+    _train_trace = "train-" + _uuid.uuid4().hex[:8]
     try:
         if ckpt is not None:
-            (model, opt_state), start = ckpt.restore((model, opt_state))
+            with _spans.span(
+                "train.restore", bucket="checkpoint", trace=_train_trace
+            ):
+                (model, opt_state), start = ckpt.restore((model, opt_state))
             if start > steps:
                 raise ValueError(
                     f"{checkpoint_dir} holds a step-{start} checkpoint but "
@@ -556,6 +565,7 @@ def train(
             toks = jnp.asarray(_step_batch(corpus, seed, i, batch, seq))
             if sharding is not None:
                 toks = jax.device_put(toks, sharding)
+            t_host = _time.perf_counter() - t_step0
             if guarded:
                 poison = _faults.fire("train.nan", key=i)
                 model, opt_state, loss = step(
@@ -573,14 +583,42 @@ def train(
             _cluster.note_step(completed)
             steplog = _telemetry.active_step_log()
             if steplog is not None:
+                # the float() below is the one per-step host sync the
+                # live stream pays — measure the wall AFTER it so the
+                # recorded step time is honest under async dispatch
+                loss_f = float(loss)
+                wall = _time.perf_counter() - t_step0
                 steplog.step(
                     step=i + 1,
-                    loss=float(loss),
+                    loss=loss_f,
                     tokens=batch * seq,
-                    wall_s=_time.perf_counter() - t_step0,
+                    wall_s=wall,
                     flops=step_flops,
                     hbm_peak_bytes=devmon.maybe_sample(),
                 )
+                # the step's causal record: host-side batch production
+                # vs dispatched device work, classified for the goodput
+                # report (structural root; children carry the buckets)
+                span_log = _spans.active_span_log()
+                if span_log is not None:
+                    s_ctx = span_log.record_span(
+                        "train.step",
+                        wall_s=wall,
+                        trace=_train_trace,
+                        step=i + 1,
+                    )
+                    span_log.record_span(
+                        "train.host_batch",
+                        wall_s=t_host,
+                        bucket="wait_host",
+                        parent=s_ctx,
+                    )
+                    span_log.record_span(
+                        "train.compute",
+                        wall_s=max(wall - t_host, 0.0),
+                        bucket="compute",
+                        parent=s_ctx,
+                    )
             # one host sync per check interval, not per step
             loss_guard.note(i, loss)
             if dog is not None:
@@ -607,7 +645,13 @@ def train(
             if ckpt is not None and (
                 (i + 1) % every == 0 or (i + 1) == steps
             ):
-                ckpt.save((model, opt_state), i + 1)
+                with _spans.span(
+                    "train.checkpoint",
+                    bucket="checkpoint",
+                    trace=_train_trace,
+                    step=i + 1,
+                ):
+                    ckpt.save((model, opt_state), i + 1)
                 last_saved = i + 1
             if _faults.fire("train.sigterm", key=i):
                 if prev_handlers:
@@ -673,7 +717,14 @@ def train(
                 # preemption / signal / crash path: the loop's periodic
                 # save didn't cover the last completed step — write it
                 # now so at most the in-flight step is lost
-                ckpt.save((model, opt_state), completed)
+                with _spans.span(
+                    "train.checkpoint",
+                    bucket="checkpoint",
+                    trace=_train_trace,
+                    step=completed,
+                    rescue=True,
+                ):
+                    ckpt.save((model, opt_state), completed)
                 _emit_resilience("final_checkpoint", step=completed)
         except Exception:  # noqa: BLE001 — a failed rescue save must
             # not mask the original exception (the preemption itself)

@@ -13,6 +13,10 @@ docstring):
   ``jax.jit(...).lower().compile().cost_analysis()``
 - :mod:`.report` — per-node run summary + the ``observe`` CLI
 - :mod:`.telemetry` — live per-step stream (``steps.jsonl``)
+- :mod:`.spans` — end-to-end trace spans (``spans.jsonl``), goodput
+  buckets, and the ``observe trace`` renderer
+- :mod:`.health` — rolling-baseline anomaly monitor → ``alert`` events
+- :mod:`.schema` — the single registry of structured event kinds
 - :mod:`.devices` — per-device HBM watermark sampling
 - :mod:`.tracing` — programmatic profiler trace windows
 - :mod:`.top` — the ``observe top`` terminal dashboard
@@ -34,6 +38,9 @@ _LAZY = {
     "cost": "keystone_tpu.observe.cost",
     "report": "keystone_tpu.observe.report",
     "telemetry": "keystone_tpu.observe.telemetry",
+    "spans": "keystone_tpu.observe.spans",
+    "health": "keystone_tpu.observe.health",
+    "schema": "keystone_tpu.observe.schema",
     "devices": "keystone_tpu.observe.devices",
     "tracing": "keystone_tpu.observe.tracing",
     "top": "keystone_tpu.observe.top",

@@ -40,12 +40,31 @@ import time
 import uuid
 from typing import Any, Iterator
 
+from keystone_tpu.observe.schema import note as _schema_note
+
 ENV_DIR = "KEYSTONE_OBSERVE_DIR"
+ENV_MAX_MB = "KEYSTONE_OBSERVE_MAX_MB"
 EVENTS_FILE = "events.jsonl"
 
 # in-memory mirror cap: a runaway loop must not grow the host heap
 # without bound just because observability is on
 _MAX_MEMORY_RECORDS = 100_000
+
+
+def max_bytes_from_env() -> int | None:
+    """Size cap for the high-rate JSONL streams (``steps.jsonl`` /
+    ``spans.jsonl``): ``KEYSTONE_OBSERVE_MAX_MB`` megabytes per file
+    before rotation, None = unbounded (the default — events.jsonl is
+    never rotated, a report needs its run_start/run_end brackets)."""
+    raw = os.environ.get(ENV_MAX_MB, "").strip()
+    if raw:
+        try:
+            mb = float(raw)
+            if mb > 0:
+                return int(mb * 2**20)
+        except ValueError:
+            pass
+    return None
 
 
 def node_label(node: Any, index: int | None = None) -> str:
@@ -61,17 +80,24 @@ def node_label(node: Any, index: int | None = None) -> str:
     return f"{index:02d}:{name}" if index is not None else name
 
 
+def _encode(rec: dict) -> str | None:
+    """One record → one JSONL line (``default=repr``: a non-JSON field
+    is a per-record problem, stringify it rather than losing the
+    record; a circular reference skips the record → None)."""
+    try:
+        return json.dumps(rec, default=repr)
+    except ValueError:  # circular reference: skip this record
+        return None
+
+
 def write_record(fh, rec: dict, sink_name: str):
     """Serialize ``rec`` and append it to JSONL sink ``fh`` — the ONE
     home of the write-or-degrade contract shared by the event log and
-    the step-telemetry stream (``default=repr``: a non-JSON field is a
-    per-record problem, stringify it rather than losing the record; a
-    circular reference skips the record; an OSError disables the sink
-    with one warning). Returns ``fh``, or None when the sink must be
-    disabled. The caller holds its own lock."""
-    try:
-        line = json.dumps(rec, default=repr)
-    except ValueError:  # circular reference: skip this record
+    the per-record streams (an OSError disables the sink with one
+    warning). Returns ``fh``, or None when the sink must be disabled.
+    The caller holds its own lock."""
+    line = _encode(rec)
+    if line is None:
         return fh
     try:
         fh.write(line + "\n")
@@ -83,6 +109,91 @@ def write_record(fh, rec: dict, sink_name: str):
         )
         return None
     return fh
+
+
+class JsonlSink:
+    """An append-only JSONL file with write-or-degrade semantics and
+    size-based rotation — the sink behind the high-rate streams
+    (``steps.jsonl``, ``spans.jsonl``), which otherwise grow without
+    bound on long runs.
+
+    When a write would push the file past ``max_bytes``
+    (``KEYSTONE_OBSERVE_MAX_MB``; None = unbounded), the current file
+    is renamed to ``<path>.1`` (replacing the previous generation) and
+    a fresh file is started — so on-disk usage is bounded by ~2x the
+    cap, and a reader always sees the newest records. The incremental
+    tailer (:class:`keystone_tpu.observe.top.Tail`) detects the
+    truncation and restarts; the tolerant reader (:func:`read_jsonl`)
+    already survives any torn seam. NOT thread-safe — the owning log
+    holds its own lock around :meth:`write`."""
+
+    def __init__(
+        self, path: str, sink_name: str, max_bytes: int | None = None
+    ):
+        self.path = path
+        self.sink_name = sink_name
+        self.max_bytes = (
+            max_bytes_from_env() if max_bytes is None else max_bytes
+        )
+        self._fh = open(path, "a", buffering=1)  # noqa: SIM115 — run-lifetime
+        self._size = self._fh.tell()
+
+    def _rotate(self) -> None:
+        try:
+            self._fh.close()
+            os.replace(self.path, self.path + ".1")
+            self._fh = open(  # noqa: SIM115 — run-lifetime
+                self.path, "a", buffering=1
+            )
+            self._size = 0
+        except OSError as e:
+            from keystone_tpu.core.logging import get_logger
+
+            get_logger("keystone_tpu.observe").warning(
+                "%s rotation failed (%r); file sink disabled",
+                self.sink_name,
+                e,
+            )
+            self._fh = None
+
+    def write(self, rec: dict) -> None:
+        if self._fh is None:
+            return
+        line = _encode(rec)
+        if line is None:
+            return
+        # size in encoded BYTES (the unit the cap and tell() use) — a
+        # code-point count under-measures multi-byte records and would
+        # rotate late
+        nbytes = len(line.encode("utf-8")) + 1
+        if (
+            self.max_bytes
+            and self._size
+            and self._size + nbytes > self.max_bytes
+        ):
+            self._rotate()
+            if self._fh is None:
+                return
+        try:
+            self._fh.write(line + "\n")
+            self._size += nbytes
+        except OSError as e:
+            from keystone_tpu.core.logging import get_logger
+
+            get_logger("keystone_tpu.observe").warning(
+                "%s write failed (%r); file sink disabled",
+                self.sink_name,
+                e,
+            )
+            self._fh = None
+
+    def close(self) -> None:
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+            self._fh = None
 
 
 class EventLog:
@@ -111,6 +222,9 @@ class EventLog:
             )
 
     def emit(self, event: str, **fields: Any) -> dict:
+        # schema drift check: every kind must be declared in ONE place
+        # (observe/schema.py); unknown kinds warn once, never drop
+        _schema_note(event)
         rec = {"ts": time.time(), "run": self.run_id, "event": event}
         rec.update(fields)
         with self._lock:
@@ -150,11 +264,13 @@ class EventLog:
         )
 
     def close(self) -> None:
-        # the per-step telemetry stream (observe/telemetry.py) binds its
-        # StepLog to this log's lifetime — close it with the run
-        steplog = self.__dict__.pop("_steplog", None)
-        if steplog is not None:
-            steplog.close()
+        # the per-step telemetry stream (observe/telemetry.py) and the
+        # span trace stream (observe/spans.py) bind their sinks to this
+        # log's lifetime — close them with the run
+        for bound in ("_steplog", "_spanlog"):
+            sub = self.__dict__.pop(bound, None)
+            if sub is not None:
+                sub.close()
         with self._lock:
             if self._fh is not None:
                 try:
@@ -278,6 +394,13 @@ def run(
     with _state_lock:
         prev = _active
         _active = log
+    # a new scoped run means new baselines: without this, the anomaly
+    # monitor would carry a previous run's frozen step-wall p95 / loss
+    # EMA into an unrelated workload and mis-alert (bench runs several
+    # training loops of different sizes in one process)
+    from keystone_tpu.observe.health import reset_monitor
+
+    reset_monitor()
     log.emit("run_start", **meta)
     t0 = time.perf_counter()
     try:
@@ -320,6 +443,20 @@ def read_events(path: str) -> list[dict]:
     readable and the loss stays visible."""
     run_dir = resolve_run_dir(path)
     return read_jsonl(os.path.join(run_dir, EVENTS_FILE))
+
+
+def read_jsonl_rotated(file_path: str) -> list[dict]:
+    """Like :func:`read_jsonl`, but stitches the rotated generation a
+    :class:`JsonlSink` may have left (``<path>.1`` first, then the
+    current file — oldest→newest). The ONE reader for the size-capped
+    streams (``steps.jsonl``, ``spans.jsonl``): a consumer that read
+    only the current file would silently drop the run's earliest
+    records — exactly the baseline window the drift checks freeze on."""
+    out: list[dict] = []
+    for path in (file_path + ".1", file_path):
+        if os.path.isfile(path):
+            out.extend(read_jsonl(path))
+    return out
 
 
 def read_jsonl(file_path: str) -> list[dict]:

@@ -1,0 +1,400 @@
+"""Rolling-baseline anomaly monitor: a run that quietly degrades alerts.
+
+The observe stack so far *records* — nobody notices when step time
+drifts 2x, loss spikes, HBM creeps toward the limit, or the serving
+path starts missing its SLO. This module closes that gap: cheap rolling
+baselines over the live step stream (:mod:`.telemetry` feeds
+:meth:`HealthMonitor.note_step`) and the serve request path
+(:mod:`keystone_tpu.serve` feeds :meth:`note_request` /
+:meth:`note_dispatch`), emitting one ``alert`` event per verdict
+through the resilience emit schema (:func:`..resilience.emit.decision`
+— one counter bump + one event when a sink is active, one global read
+when not). ``observe top`` and the run report render them.
+
+Alert kinds (the ``action`` field):
+
+==========================  ============================================
+``train.nan_loss``          a non-finite loss reached the step stream
+``train.loss_spike``        loss > ``loss_spike_factor`` x its EMA
+``train.step_time_drift``   rolling step-wall p95 >
+                            ``step_p95_factor`` x the frozen baseline
+``train.hbm_growth``        HBM peak watermark grew past
+                            ``hbm_growth_factor`` x its first sample
+``serve.slow_request``      one request's wall exceeded the tail-latency
+                            threshold (``KEYSTONE_SERVE_SLOW_MS``)
+``serve.deadline_miss``     dispatch-time deadline-miss rate over the
+                            rolling window breached
+``serve.shed_rate``         admission-shed rate breached
+==========================  ============================================
+
+Determinism: verdicts are pure functions of the fed values plus an
+injectable clock (request-side cooldowns), so the fault drills —
+``KEYSTONE_FAULTS="train.nan:@k:0"`` / ``serve.slow_request:@k:0`` —
+produce the same alerts every run, and the tests drive everything with
+zero sleeps. :func:`check_run` replays a finished run's ``steps.jsonl``
+through a fresh (non-emitting) monitor, so the report can show what a
+live monitor *would* have said about a run recorded without one.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import math
+import os
+import threading
+import time
+from typing import Any, Callable
+
+ENV_SLOW_MS = "KEYSTONE_SERVE_SLOW_MS"  # shared with serve/server.py
+
+
+def _slow_threshold_s() -> float:
+    try:
+        return float(os.environ.get(ENV_SLOW_MS, "") or 100.0) / 1e3
+    except ValueError:
+        return 0.1
+
+
+@dataclasses.dataclass
+class HealthConfig:
+    """Thresholds for every check; env overrides via
+    ``KEYSTONE_ALERT_<FIELD>`` (floats/ints, upper-cased field name)."""
+
+    baseline_steps: int = 16  # steps frozen as the step-wall baseline
+    window: int = 32  # rolling window the drift p95 is taken over
+    step_p95_factor: float = 2.0
+    loss_spike_factor: float = 3.0
+    loss_ema_alpha: float = 0.1
+    loss_warmup: int = 4  # EMA samples before spike checks arm
+    hbm_growth_factor: float = 1.5
+    deadline_miss_rate: float = 0.5
+    shed_rate: float = 0.05
+    rate_min_requests: int = 20
+    rate_window: int = 64  # requests the miss/shed rates slide over
+    cooldown_steps: int = 32  # min steps between repeats of one kind
+    cooldown_s: float = 30.0  # request-side repeat suppression
+    slow_request_s: float | None = None  # None → KEYSTONE_SERVE_SLOW_MS
+
+    @classmethod
+    def from_env(cls) -> "HealthConfig":
+        cfg = cls()
+        for f in dataclasses.fields(cls):
+            raw = os.environ.get(f"KEYSTONE_ALERT_{f.name.upper()}")
+            if raw is None or not raw.strip():
+                continue
+            try:
+                setattr(
+                    cfg,
+                    f.name,
+                    int(raw) if f.type == "int" else float(raw),
+                )
+            except ValueError:
+                pass
+        return cfg
+
+
+class HealthMonitor:
+    """Per-process anomaly monitor. All methods are thread-safe and
+    cheap on the no-verdict path (a few float compares); an alert costs
+    one counter bump + one event emit (when a sink is active).
+
+    ``emit=False`` collects verdicts in :attr:`alerts` only — the
+    offline-replay form :func:`check_run` uses.
+    """
+
+    def __init__(
+        self,
+        config: HealthConfig | None = None,
+        *,
+        clock: Callable[[], float] = time.monotonic,
+        emit: bool = True,
+    ):
+        self.config = config or HealthConfig.from_env()
+        self.clock = clock
+        self.emit = emit
+        self.alerts: list[dict] = []
+        self._lock = threading.Lock()
+        c = self.config
+        self._baseline: list[float] = []
+        self._baseline_p95: float | None = None
+        self._walls: collections.deque = collections.deque(maxlen=c.window)
+        self._loss_ema: float | None = None
+        self._loss_n = 0
+        self._hbm_base: float | None = None
+        self._req_total = 0
+        # SLIDING windows, not lifetime totals: a server that was
+        # healthy for hours must alert within one window of an SLO
+        # collapse, and a cold-start burst must age out instead of
+        # re-firing against healthy current traffic
+        self._req_recent: collections.deque = collections.deque(
+            maxlen=max(c.rate_window, 1)
+        )
+        self._disp_recent: collections.deque = collections.deque(
+            maxlen=max(c.rate_window, 1)
+        )
+        self._last_step_fire: dict[str, int] = {}
+        self._last_time_fire: dict[str, float] = {}
+
+    # ------------------------------------------------------------ firing
+
+    def _fire(
+        self, kind: str, *, step: int | None = None, **detail: Any
+    ) -> None:
+        rec = {"kind": kind, "step": step, **detail}
+        with self._lock:
+            self.alerts.append(rec)
+        if not self.emit:
+            return
+        from keystone_tpu.resilience.emit import decision
+
+        decision(
+            kind,
+            counter="alerts",
+            counter_labels={"kind": kind},
+            event_kind="alert",
+            phase="health",
+            step=step,
+            **detail,
+        )
+
+    def _step_cooldown_ok(self, kind: str, step: int) -> bool:
+        last = self._last_step_fire.get(kind)
+        if last is not None and step - last < self.config.cooldown_steps:
+            return False
+        self._last_step_fire[kind] = step
+        return True
+
+    def _time_cooldown_ok(self, kind: str) -> bool:
+        now = self.clock()
+        last = self._last_time_fire.get(kind)
+        if last is not None and now - last < self.config.cooldown_s:
+            return False
+        self._last_time_fire[kind] = now
+        return True
+
+    # ------------------------------------------------------ train stream
+
+    def note_step(
+        self,
+        *,
+        step: int,
+        loss: float | None = None,
+        wall_s: float | None = None,
+        hbm_peak_bytes: float | None = None,
+    ) -> None:
+        """One completed train step (the :class:`..telemetry.StepLog`
+        hook — source="train" rows only)."""
+        c = self.config
+        fires: list[tuple[str, dict]] = []
+        with self._lock:
+            if loss is not None:
+                loss = float(loss)
+                if not math.isfinite(loss):
+                    fires.append(("train.nan_loss", {"loss": repr(loss)}))
+                else:
+                    if (
+                        self._loss_ema is not None
+                        and self._loss_n >= c.loss_warmup
+                        and loss > self._loss_ema * c.loss_spike_factor
+                        and self._loss_ema > 0
+                    ):
+                        fires.append(
+                            (
+                                "train.loss_spike",
+                                {
+                                    "loss": round(loss, 6),
+                                    "ema": round(self._loss_ema, 6),
+                                    "factor": c.loss_spike_factor,
+                                },
+                            )
+                        )
+                    self._loss_ema = (
+                        loss
+                        if self._loss_ema is None
+                        else (1 - c.loss_ema_alpha) * self._loss_ema
+                        + c.loss_ema_alpha * loss
+                    )
+                    self._loss_n += 1
+            if wall_s is not None and wall_s >= 0:
+                if self._baseline_p95 is None:
+                    # the first steps after compile ARE the baseline; the
+                    # caller (train loop) starts feeding from step 1, and
+                    # the first step's compile wall would poison it — so
+                    # the baseline freezes over steps 2..baseline+1
+                    if step > 1:
+                        self._baseline.append(float(wall_s))
+                        if len(self._baseline) >= c.baseline_steps:
+                            self._baseline_p95 = _p95(self._baseline)
+                else:
+                    self._walls.append(float(wall_s))
+                    if len(self._walls) >= max(c.window // 2, 4):
+                        p95 = _p95(self._walls)
+                        if p95 > self._baseline_p95 * c.step_p95_factor:
+                            fires.append(
+                                (
+                                    "train.step_time_drift",
+                                    {
+                                        "p95_s": round(p95, 6),
+                                        "baseline_p95_s": round(
+                                            self._baseline_p95, 6
+                                        ),
+                                        "factor": c.step_p95_factor,
+                                    },
+                                )
+                            )
+            if hbm_peak_bytes:
+                if self._hbm_base is None:
+                    self._hbm_base = float(hbm_peak_bytes)
+                elif hbm_peak_bytes > self._hbm_base * c.hbm_growth_factor:
+                    fires.append(
+                        (
+                            "train.hbm_growth",
+                            {
+                                "hbm_peak_bytes": int(hbm_peak_bytes),
+                                "baseline_bytes": int(self._hbm_base),
+                                "factor": c.hbm_growth_factor,
+                            },
+                        )
+                    )
+                    # ratchet: re-alert only at the NEXT factor of growth
+                    self._hbm_base = float(hbm_peak_bytes)
+            fires = [
+                (kind, detail)
+                for kind, detail in fires
+                if self._step_cooldown_ok(kind, step)
+            ]
+        for kind, detail in fires:
+            self._fire(kind, step=step, **detail)
+
+    # ------------------------------------------------------ serve stream
+
+    def note_request(
+        self, wall_s: float, *, shed: bool = False, rid: Any = None
+    ) -> None:
+        """One finished (or shed) front-end request."""
+        c = self.config
+        fires: list[tuple[str, dict]] = []
+        with self._lock:
+            self._req_total += 1
+            self._req_recent.append(bool(shed))
+            if shed:
+                window_shed = sum(self._req_recent)
+                if (
+                    len(self._req_recent) >= c.rate_min_requests
+                    and window_shed / len(self._req_recent) > c.shed_rate
+                    and self._time_cooldown_ok("serve.shed_rate")
+                ):
+                    fires.append(
+                        (
+                            "serve.shed_rate",
+                            {
+                                "shed": window_shed,
+                                "window": len(self._req_recent),
+                            },
+                        )
+                    )
+            threshold = (
+                _slow_threshold_s()
+                if c.slow_request_s is None
+                else c.slow_request_s
+            )
+            if (
+                not shed
+                and wall_s > threshold
+                and self._time_cooldown_ok("serve.slow_request")
+            ):
+                fires.append(
+                    (
+                        "serve.slow_request",
+                        {
+                            "wall_s": round(wall_s, 6),
+                            "threshold_s": round(threshold, 6),
+                            "rid": rid,
+                        },
+                    )
+                )
+        for kind, detail in fires:
+            self._fire(kind, **detail)
+
+    def note_dispatch(self, *, requests: int, misses: int) -> None:
+        """One micro-batch dispatch: how many of its requests had
+        already waited past their SLO deadline when it shipped."""
+        c = self.config
+        fire = None
+        with self._lock:
+            for i in range(int(requests)):
+                self._disp_recent.append(i < int(misses))
+            window_miss = sum(self._disp_recent)
+            if (
+                len(self._disp_recent) >= c.rate_min_requests
+                and window_miss / len(self._disp_recent)
+                > c.deadline_miss_rate
+                and self._time_cooldown_ok("serve.deadline_miss")
+            ):
+                fire = {
+                    "missed": window_miss,
+                    "window": len(self._disp_recent),
+                }
+        if fire is not None:
+            self._fire("serve.deadline_miss", **fire)
+
+
+def _p95(values) -> float:
+    vals = sorted(values)
+    if not vals:
+        return 0.0
+    return vals[min(int(round(0.95 * (len(vals) - 1))), len(vals) - 1)]
+
+
+# ----------------------------------------------------------- the singleton
+
+_monitor: HealthMonitor | None = None
+_monitor_lock = threading.Lock()
+
+
+def get_monitor() -> HealthMonitor:
+    """The process-wide monitor the telemetry and serve hooks feed."""
+    global _monitor
+    m = _monitor
+    if m is None:
+        with _monitor_lock:
+            if _monitor is None:
+                _monitor = HealthMonitor()
+            m = _monitor
+    return m
+
+
+def reset_monitor() -> None:
+    """Fresh baselines (tests; a new run in the same process)."""
+    global _monitor
+    with _monitor_lock:
+        _monitor = None
+
+
+# -------------------------------------------------------- offline replay
+
+
+def check_run(run_dir: str, config: HealthConfig | None = None) -> list[dict]:
+    """Replay a finished run's ``steps.jsonl`` through a fresh,
+    non-emitting monitor and return the verdict list — what a live
+    monitor would have alerted on."""
+    from keystone_tpu.observe import events as _events
+    from keystone_tpu.observe import telemetry as _telemetry
+
+    run_dir = _events.resolve_run_dir(run_dir)
+    path = os.path.join(run_dir, _telemetry.STEPS_FILE)
+    mon = HealthMonitor(config, emit=False)
+    # rotation-aware: the drift baseline freezes on the run's FIRST
+    # post-compile steps, which live in the rotated generation on a
+    # long capped run
+    for rec in _events.read_jsonl_rotated(path):
+        if rec.get("source", "train") != "train" or "step" not in rec:
+            continue
+        mon.note_step(
+            step=int(rec["step"]),
+            loss=rec.get("loss"),
+            wall_s=rec.get("wall_s"),
+            hbm_peak_bytes=rec.get("hbm_peak_bytes"),
+        )
+    return mon.alerts

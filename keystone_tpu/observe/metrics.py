@@ -201,6 +201,39 @@ class Timer:
             return out
 
 
+def _prom_name(name: str) -> str:
+    """Sanitize to the Prometheus metric-name charset
+    ``[a-zA-Z_:][a-zA-Z0-9_:]*`` (our names are already snake_case;
+    this guards dynamically-built ones)."""
+    out = "".join(
+        c if c.isascii() and (c.isalnum() or c in "_:") else "_"
+        for c in name
+    )
+    if not out or out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _prom_labels(labels: dict[str, Any]) -> str:
+    if not labels:
+        return ""
+    parts = []
+    for k in sorted(labels):
+        v = str(labels[k])
+        v = (
+            v.replace("\\", "\\\\")
+            .replace('"', '\\"')
+            .replace("\n", "\\n")
+        )
+        parts.append(f'{_prom_name(str(k))}="{v}"')
+    return "{" + ",".join(parts) + "}"
+
+
+def _prom_value(v: Any) -> str:
+    f = float(v)
+    return repr(int(f)) if f.is_integer() and abs(f) < 2**53 else repr(f)
+
+
 class MetricsRegistry:
     """Get-or-create home of all labeled series in one process."""
 
@@ -260,6 +293,49 @@ class MetricsRegistry:
             else:
                 out[key] = {"kind": kind, "value": series.value}
         return out
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition (format 0.0.4) of every series —
+        the ``/metrics`` scrape body. Counters and gauges map directly;
+        timers render as a summary family: ``<name>_count``,
+        ``<name>_sum`` (seconds), and reservoir-estimated
+        ``quantile="0.5|0.95|0.99"`` sample lines. Metric names are
+        sanitized to the Prometheus charset; label values escape
+        backslash, quote, and newline per the exposition rules."""
+        with self._lock:
+            items = list(self._series.items())
+        families: dict[tuple[str, str], list[str]] = {}
+        for key, (kind, series) in sorted(items):
+            name, labels = parse_series_key(key)
+            pname = _prom_name(name)
+            fam = families.setdefault((pname, kind), [])
+            if kind == "timer":
+                summ = series.summary()
+                fam.append(
+                    f"{pname}_count{_prom_labels(labels)} {summ['count']}"
+                )
+                fam.append(
+                    f"{pname}_sum{_prom_labels(labels)} "
+                    f"{_prom_value(summ['total_s'])}"
+                )
+                for q, field in ((0.5, "p50_s"), (0.95, "p95_s"), (0.99, "p99_s")):
+                    if field in summ:
+                        fam.append(
+                            f"{pname}"
+                            f"{_prom_labels({**labels, 'quantile': q})} "
+                            f"{_prom_value(summ[field])}"
+                        )
+            else:
+                fam.append(
+                    f"{pname}{_prom_labels(labels)} "
+                    f"{_prom_value(series.value)}"
+                )
+        lines: list[str] = []
+        type_names = {"counter": "counter", "gauge": "gauge", "timer": "summary"}
+        for (pname, kind), fam in families.items():
+            lines.append(f"# TYPE {pname} {type_names[kind]}")
+            lines.extend(fam)
+        return "\n".join(lines) + ("\n" if lines else "")
 
     def reset(self) -> None:
         with self._lock:

@@ -47,6 +47,7 @@ def summarize(events: list[dict]) -> dict[str, Any]:
     optimizes: list[dict] = []
     clusters: list[dict] = []
     serves: list[dict] = []
+    alerts: list[dict] = []
     device_memory: dict | None = None
     trace_windows: list[dict] = []
     meta: dict[str, Any] = {"run": None, "wall_s": None, "status": None}
@@ -79,6 +80,8 @@ def summarize(events: list[dict]) -> dict[str, Any]:
             clusters.append(ev)
         elif kind == "serve":
             serves.append(ev)
+        elif kind == "alert":
+            alerts.append(ev)
         elif kind == "device_memory":
             device_memory = ev  # latest sample carries current watermarks
         elif kind == "trace_window":
@@ -95,6 +98,7 @@ def summarize(events: list[dict]) -> dict[str, Any]:
         "optimizes": optimizes,
         "clusters": clusters,
         "serves": serves,
+        "alerts": alerts,
         "device_memory": device_memory,
         "trace_windows": trace_windows,
     }
@@ -238,6 +242,8 @@ def render(run_dir: str) -> str:
             )
             lines.append(f"  {ev.get('action', '?')}: {fields}")
         lines.append("")
+    lines.extend(_alert_section(run_dir, summary))
+    lines.extend(_goodput_section(run_dir))
     lines.extend(_telemetry_sections(run_dir, summary))
     if peak is None and profiles:
         lines.append(
@@ -245,6 +251,66 @@ def render(run_dir: str) -> str:
             "roofline basis: ROOFLINE.md)"
         )
     return "\n".join(lines)
+
+
+def _alert_section(run_dir: str, summary: dict) -> list[str]:
+    """Recorded ``alert`` events (the live anomaly monitor's verdicts);
+    when the run recorded none, the step stream is replayed offline
+    through the same checks so a sink-only run still gets a verdict."""
+    lines: list[str] = []
+    alerts = summary.get("alerts") or []
+    offline = False
+    if not alerts:
+        try:
+            from keystone_tpu.observe import health as _health
+
+            alerts = [
+                {"action": a.get("kind"), **a} for a in _health.check_run(run_dir)
+            ]
+            offline = True
+        except Exception:  # noqa: BLE001 — the report must render
+            alerts = []
+    if not alerts:
+        return lines
+    by_kind: dict[str, int] = {}
+    for a in alerts:
+        kind = str(a.get("action", "?"))
+        by_kind[kind] = by_kind.get(kind, 0) + 1
+    lines.append(
+        "alerts"
+        + (" (offline scan of steps.jsonl)" if offline else "")
+        + ": "
+        + "  ".join(f"{k}={v}" for k, v in sorted(by_kind.items()))
+    )
+    for a in alerts[-5:]:
+        fields = ", ".join(
+            f"{k}={v}"
+            for k, v in a.items()
+            if k not in ("event", "ts", "run", "phase", "action", "kind")
+            and v is not None
+        )
+        lines.append(f"  {a.get('action', '?')}: {fields}")
+    lines.append("")
+    return lines
+
+
+def _goodput_section(run_dir: str) -> list[str]:
+    """The span stream's "where the time went" breakdown, when the run
+    recorded spans."""
+    from keystone_tpu.observe import spans as _spans
+
+    try:
+        span_recs = _spans.read_spans(run_dir)
+    except OSError:
+        return []
+    if not span_recs:
+        return []
+    lines = _spans.render_goodput(_spans.goodput_summary(span_recs))
+    lines.append(
+        "  (span trees: python -m keystone_tpu observe trace <run-dir>)"
+    )
+    lines.append("")
+    return lines
 
 
 def _telemetry_sections(run_dir: str, summary: dict) -> list[str]:
@@ -256,8 +322,10 @@ def _telemetry_sections(run_dir: str, summary: dict) -> list[str]:
 
     lines: list[str] = []
     steps_path = os.path.join(run_dir, _telemetry.STEPS_FILE)
-    if os.path.isfile(steps_path):
-        recs = _events.read_jsonl(steps_path)
+    if os.path.isfile(steps_path) or os.path.isfile(steps_path + ".1"):
+        # rotation-aware: a size-capped run's earliest records live in
+        # the .1 generation
+        recs = _events.read_jsonl_rotated(steps_path)
         # plan chunk-stream rows (source="plan") carry whole-stream
         # walls on a process-lifetime sequence — summarized separately
         # so they can't inflate the per-step percentiles
@@ -476,14 +544,23 @@ def main(argv: list[str] | None = None) -> None:
         from keystone_tpu.observe import top as _top
 
         return _top.main(argv[1:])
+    if argv and argv[0] == "trace":
+        # span trees: `observe trace <dir> [--request ID] [--limit N]`
+        from keystone_tpu.observe import spans as _spans
+
+        return _spans.main(argv[1:])
     if not argv or argv[0] in ("-h", "--help"):
         raise SystemExit(
             "usage: python -m keystone_tpu observe <run-dir>\n"
             "       python -m keystone_tpu observe top <run-dir> [--once]"
             " [--interval S]\n"
+            "       python -m keystone_tpu observe trace <run-dir>"
+            " [--request ID] [--limit N]\n"
             "<run-dir> is a directory containing events.jsonl, or a base\n"
             "KEYSTONE_OBSERVE_DIR (the newest run under it is rendered);\n"
-            "`top` tails steps.jsonl/events.jsonl as a live dashboard"
+            "`top` tails steps.jsonl/events.jsonl as a live dashboard;\n"
+            "`trace` renders spans.jsonl as per-trace span trees with a\n"
+            "critical-path summary and the goodput bucket breakdown"
         )
     try:
         print(render(argv[0]))

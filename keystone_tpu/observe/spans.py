@@ -1,0 +1,505 @@
+"""End-to-end span tracing: the causal layer of observe/.
+
+The event log records *what ran* and the step stream *how fast*; this
+module records *what caused what*: every hop a unit of work takes —
+request → micro-batch → plan segment → staged chunk → device — becomes
+one span record in ``<run-dir>/spans.jsonl``, linked by
+``(trace, span, parent)`` ids that survive thread boundaries. A served
+request, a train step, or a planned pass can then be rendered as a tree
+(``python -m keystone_tpu observe trace <dir>``) and its wall decomposed
+into *where the time went* buckets — the per-stage stall/goodput signal
+the self-tuning planner (ROADMAP item 3) needs.
+
+Activation mirrors :mod:`.telemetry` exactly: a :class:`SpanLog` exists
+only while an event sink is active, and :func:`active_span_log` /
+:func:`span` cost ONE global read returning None on the disabled path.
+
+Span record schema (one JSON object per line; extra fields free-form):
+
+==============  ========================================================
+``ts``          unix time at emission (float, seconds)
+``run``         run id (same id as the run's events)
+``trace``       trace id — all spans of one causal unit share it
+``span``        this span's id
+``parent``      parent span id (absent for roots)
+``name``        span name, dotted by subsystem (``serve.queue_wait``,
+                ``plan.segment``, ``staging.h2d``, ``train.step``)
+``wall_s``      wall-clock duration
+``bucket``      goodput bucket (see :data:`BUCKETS`), absent on
+                structural spans whose children carry the time
+``status``      ``failed`` when the bracket raised (absent = ok)
+==============  ========================================================
+
+Thread boundaries: the ambient span context rides a ``contextvars``
+variable, which does NOT flow into an already-running worker thread —
+so the micro-batcher captures :func:`current` at submit time, the
+staging engine at stream creation, and the decode loop at prompt
+submit, then records spans with that explicit parent. That is the whole
+propagation protocol; there is no global registry of live spans.
+
+Goodput buckets (:data:`BUCKETS`) classify a span's wall:
+
+==============  ========================================================
+``queue``       admitted but waiting for coalescing/capacity
+``wait_host``   host-side input production + host→device transfer
+``wait_device`` blocked on device results (``block_until_ready``)
+``compute``     dispatched device work (incl. the queued dispatch wall)
+``collective``  cross-host barriers / merges
+``checkpoint``  checkpoint save/restore
+==============  ========================================================
+
+Spans can overlap (staging overlaps compute by design), so bucket
+shares are reported over the *classified* wall, not the run wall.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import contextvars
+import os
+import threading
+import time
+import uuid
+from typing import Any, Iterator, NamedTuple
+
+from keystone_tpu.observe import events as _events
+
+SPANS_FILE = "spans.jsonl"
+
+#: the goodput taxonomy — every classified span names one of these
+BUCKETS = (
+    "queue",
+    "wait_host",
+    "wait_device",
+    "compute",
+    "collective",
+    "checkpoint",
+)
+
+# in-memory mirror cap — enough for the bench's goodput summaries and
+# the trace renderer without growing with run length
+_MAX_MEMORY_SPANS = 8192
+
+_bind_lock = threading.Lock()
+_UNSET: Any = object()
+
+
+class SpanContext(NamedTuple):
+    """The ids a child span needs from its parent — pass this across
+    thread boundaries explicitly (contextvars stop at threads)."""
+
+    trace: str
+    span: str
+
+
+def _new_id() -> str:
+    return uuid.uuid4().hex[:12]
+
+
+def make_context(
+    parent: SpanContext | None = None, trace: str | None = None
+) -> SpanContext:
+    """Pre-allocate a span's ids so children recorded earlier (e.g. a
+    prefill recorded at admit, inside a generation span recorded at
+    retire) can parent on it before it is emitted."""
+    t = trace or (parent.trace if parent is not None else _new_id())
+    return SpanContext(t, _new_id())
+
+
+# the ambient span: what a nested `span()` parents on when no explicit
+# parent is given. Context-local, so concurrent requests never cross.
+_current: contextvars.ContextVar[SpanContext | None] = contextvars.ContextVar(
+    "keystone_span", default=None
+)
+
+
+def current() -> SpanContext | None:
+    """The ambient span context (None outside any span). A plain
+    context-local read — safe on any hot path."""
+    return _current.get()
+
+
+class SpanLog:
+    """One run's span sink: ``spans.jsonl`` (size-rotated under
+    ``KEYSTONE_OBSERVE_MAX_MB``) plus a bounded in-memory mirror.
+
+    ``run_dir=None`` gives a memory-only log. Thread-safe; disk-write
+    failure degrades with one warning, same rule as the event log.
+    """
+
+    def __init__(self, run_dir: str | None = None, run_id: str | None = None):
+        self.run_id = run_id
+        self.records: collections.deque = collections.deque(
+            maxlen=_MAX_MEMORY_SPANS
+        )
+        self._lock = threading.Lock()
+        self._sink: _events.JsonlSink | None = None
+        if run_dir:
+            try:
+                self._sink = _events.JsonlSink(
+                    os.path.join(run_dir, SPANS_FILE), "span log"
+                )
+            except OSError as e:
+                from keystone_tpu.core.logging import get_logger
+
+                get_logger("keystone_tpu.observe").warning(
+                    "cannot open %s under %s (%r); span tracing is "
+                    "memory-only for this run",
+                    SPANS_FILE,
+                    run_dir,
+                    e,
+                )
+
+    def record_span(
+        self,
+        name: str,
+        *,
+        wall_s: float,
+        bucket: str | None = None,
+        parent: SpanContext | None = None,
+        trace: str | None = None,
+        ctx: SpanContext | None = None,
+        status: str | None = None,
+        **attrs: Any,
+    ) -> SpanContext:
+        """Emit one already-measured span and return its context.
+
+        ``ctx`` reuses pre-allocated ids (:func:`make_context`);
+        otherwise the trace comes from ``trace``, else the ``parent``,
+        else a fresh one (a root)."""
+        if ctx is None:
+            ctx = make_context(parent, trace)
+        rec: dict[str, Any] = {
+            "ts": time.time(),
+            "trace": ctx.trace,
+            "span": ctx.span,
+            "name": name,
+            "wall_s": round(float(wall_s), 6),
+        }
+        if self.run_id:
+            rec["run"] = self.run_id
+        if parent is not None:
+            rec["parent"] = parent.span
+        if bucket is not None:
+            rec["bucket"] = bucket
+        if status is not None:
+            rec["status"] = status
+        for k, v in attrs.items():
+            if v is not None:
+                rec[k] = v
+        with self._lock:
+            self.records.append(rec)
+            if self._sink is not None:
+                self._sink.write(rec)
+        return ctx
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sink is not None:
+                self._sink.close()
+                self._sink = None
+
+
+def active_span_log() -> SpanLog | None:
+    """The :class:`SpanLog` riding the active event sink, or None.
+
+    The ONLY check the hot paths make: with no sink active this is
+    exactly one global read (``events.active()``) and constructs
+    nothing — the same overhead contract as
+    :func:`keystone_tpu.observe.telemetry.active_step_log`."""
+    log = _events.active()
+    if log is None:
+        return None
+    sl = log.__dict__.get("_spanlog")
+    if sl is None:
+        with _bind_lock:
+            sl = log.__dict__.get("_spanlog")
+            if sl is None:
+                sl = SpanLog(log.run_dir, log.run_id)
+                log._spanlog = sl
+    return sl
+
+
+@contextlib.contextmanager
+def span(
+    name: str,
+    *,
+    bucket: str | None = None,
+    parent: Any = _UNSET,
+    trace: str | None = None,
+    log: Any = _UNSET,
+    **attrs: Any,
+) -> Iterator[SpanContext | None]:
+    """Bracket a block as one span: measures wall, parents on the
+    ambient context (or an explicit ``parent``), installs itself as the
+    ambient context for the duration, and emits on exit (``status:
+    failed`` rides a raised exception out).
+
+    With no sink active this yields None after exactly one global read
+    — pass ``log=`` (a :class:`SpanLog` or None) to skip even that when
+    the caller already looked it up once for a whole batch/stream.
+    """
+    sl = active_span_log() if log is _UNSET else log
+    if sl is None:
+        yield None
+        return
+    pctx = _current.get() if parent is _UNSET else parent
+    ctx = make_context(pctx, trace)
+    token = _current.set(ctx)
+    t0 = time.perf_counter()
+    status = None
+    try:
+        yield ctx
+    except BaseException:
+        status = "failed"
+        raise
+    finally:
+        _current.reset(token)
+        sl.record_span(
+            name,
+            wall_s=time.perf_counter() - t0,
+            bucket=bucket,
+            parent=pctx,
+            ctx=ctx,
+            status=status,
+            **attrs,
+        )
+
+
+# --------------------------------------------------------------- analysis
+
+
+def read_spans(run_dir: str) -> list[dict]:
+    """A run's span records, rotated generation first (so order is
+    oldest→newest); [] when the run recorded none."""
+    run_dir = _events.resolve_run_dir(run_dir)
+    return _events.read_jsonl_rotated(os.path.join(run_dir, SPANS_FILE))
+
+
+def build_trees(spans: list[dict]) -> dict[str, list[dict]]:
+    """Group spans into per-trace trees: trace id → list of root nodes,
+    each node ``{"rec": span, "children": [nodes...]}`` (children in
+    emission order). A span whose parent never got emitted (crashed
+    writer) is promoted to a root rather than dropped."""
+    by_trace: dict[str, list[dict]] = {}
+    nodes: dict[str, dict] = {}
+    for rec in spans:
+        sid = rec.get("span")
+        if not sid:
+            continue
+        nodes[sid] = {"rec": rec, "children": []}
+    for node in nodes.values():
+        rec = node["rec"]
+        parent = nodes.get(rec.get("parent"))
+        if parent is not None and parent is not node:
+            parent["children"].append(node)
+        else:
+            by_trace.setdefault(str(rec.get("trace")), []).append(node)
+    return by_trace
+
+
+def critical_path(node: dict) -> float:
+    """Critical-path seconds through one span node: its own wall, or
+    its children's critical paths summed when they account for more
+    (children measured on other threads can exceed the parent's
+    bracket)."""
+    own = float(node["rec"].get("wall_s") or 0.0)
+    if not node["children"]:
+        return own
+    return max(own, sum(critical_path(c) for c in node["children"]))
+
+
+def trace_critical_path(roots: list[dict]) -> float:
+    return sum(critical_path(r) for r in roots)
+
+
+def goodput_summary(spans: list[dict]) -> dict[str, Any]:
+    """The per-run "where the time went" report: wall per goodput
+    bucket with its share of the classified total, plus trace count and
+    summed critical-path length. Structural spans (no ``bucket``) are
+    skipped — their time lives in their classified children — so the
+    shares never double-count a parent bracket."""
+    walls: dict[str, float] = {}
+    for rec in spans:
+        bucket = rec.get("bucket")
+        if not bucket:
+            continue
+        walls[bucket] = walls.get(bucket, 0.0) + float(
+            rec.get("wall_s") or 0.0
+        )
+    total = sum(walls.values())
+    trees = build_trees(spans)
+    cp = sum(trace_critical_path(roots) for roots in trees.values())
+    return {
+        "total_s": round(total, 6),
+        "buckets": {
+            b: {
+                "wall_s": round(w, 6),
+                "share": round(w / total, 4) if total else 0.0,
+            }
+            for b, w in sorted(
+                walls.items(), key=lambda kv: -kv[1]
+            )
+        },
+        "traces": len(trees),
+        "spans": len(spans),
+        "critical_path_s": round(cp, 6),
+    }
+
+
+# -------------------------------------------------------------- rendering
+
+
+def _render_node(node: dict, depth: int, lines: list[str]) -> None:
+    rec = node["rec"]
+    wall = float(rec.get("wall_s") or 0.0)
+    extras = []
+    if rec.get("bucket"):
+        extras.append(rec["bucket"])
+    if rec.get("status") == "failed":
+        extras.append("FAILED")
+    for key in ("rid", "step", "rows", "requests", "bucket_size", "tokens"):
+        if key in rec:
+            extras.append(f"{key}={rec[key]}")
+    tag = f"  [{', '.join(extras)}]" if extras else ""
+    lines.append(
+        f"{'  ' * depth}{rec.get('name', '?'):{max(34 - 2 * depth, 8)}} "
+        f"{wall * 1e3:9.3f} ms{tag}"
+    )
+    for child in node["children"]:
+        _render_node(child, depth + 1, lines)
+
+
+def _trace_matches_request(roots: list[dict], rid: str) -> bool:
+    return any(str(r["rec"].get("rid")) == rid for r in roots)
+
+
+def render_traces(
+    spans: list[dict], request: str | None = None, limit: int = 20
+) -> str:
+    """The ``observe trace`` body: per-trace span trees (newest first)
+    with a critical-path summary line each. ``request`` filters to
+    traces whose root carries that ``rid`` — and follows their
+    ``batch_trace`` links so the underlying micro-batch's segment/chunk
+    tree renders beneath the request's own."""
+    trees = build_trees(spans)
+    if not trees:
+        return "(no spans recorded — spans.jsonl absent or empty)"
+    order = sorted(
+        trees,
+        key=lambda t: max(
+            float(r["rec"].get("ts") or 0.0) for r in trees[t]
+        ),
+        reverse=True,
+    )
+    selected: list[str] = []
+    if request is not None:
+        selected = [t for t in order if _trace_matches_request(trees[t], request)]
+        if not selected:
+            return f"(no trace with a root span rid={request!r})"
+        # follow request → batch links: the batch trace carries the
+        # segment/staging tree the request's dispatch rode through
+        linked: list[str] = []
+        for t in selected:
+            stack = list(trees[t])
+            while stack:
+                node = stack.pop()
+                bt = node["rec"].get("batch_trace")
+                if bt and bt in trees and bt not in selected + linked:
+                    linked.append(str(bt))
+                stack.extend(node["children"])
+        selected.extend(linked)
+    else:
+        selected = order[:limit]
+    lines: list[str] = []
+    for t in selected:
+        roots = trees[t]
+        cp = trace_critical_path(roots)
+        names = sorted(
+            (
+                (critical_path(r), r["rec"].get("name", "?"))
+                for r in roots
+            ),
+            reverse=True,
+        )
+        head = names[0][1] if names else "?"
+        lines.append(
+            f"trace {t}  ({sum(1 for _ in _walk(roots))} span(s), "
+            f"critical path {cp * 1e3:.3f} ms, root {head})"
+        )
+        for root in roots:
+            _render_node(root, 1, lines)
+        lines.append("")
+    if request is None and len(order) > limit:
+        lines.append(f"... {len(order) - limit} more trace(s); --limit N")
+    return "\n".join(lines).rstrip()
+
+
+def _walk(roots: list[dict]) -> Iterator[dict]:
+    stack = list(roots)
+    while stack:
+        node = stack.pop()
+        yield node
+        stack.extend(node["children"])
+
+
+def render_goodput(summary: dict[str, Any]) -> list[str]:
+    """Text lines for the goodput section shared by ``observe trace``
+    and the run report."""
+    lines = [
+        f"goodput (where the time went — {summary['spans']} span(s), "
+        f"{summary['traces']} trace(s), classified "
+        f"{summary['total_s']:.3f}s, critical path "
+        f"{summary['critical_path_s']:.3f}s):"
+    ]
+    for bucket, row in summary["buckets"].items():
+        bar = "#" * int(round(row["share"] * 30))
+        lines.append(
+            f"  {bucket:12} {row['wall_s']:9.3f}s  "
+            f"{row['share'] * 100:5.1f}%  {bar}"
+        )
+    if not summary["buckets"]:
+        lines.append("  (no classified spans)")
+    return lines
+
+
+def main(argv: list[str] | None = None) -> None:
+    """``python -m keystone_tpu observe trace <run-dir> [--request ID]
+    [--limit N]``."""
+    import sys
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    request = None
+    if "--request" in argv:
+        i = argv.index("--request")
+        if i + 1 >= len(argv):
+            raise SystemExit("--request needs an id argument")
+        request = argv[i + 1]
+        del argv[i : i + 2]
+    limit = 20
+    if "--limit" in argv:
+        i = argv.index("--limit")
+        if i + 1 >= len(argv):
+            raise SystemExit("--limit needs a count argument")
+        try:
+            limit = int(argv[i + 1])
+        except ValueError:
+            raise SystemExit(
+                f"--limit: bad count {argv[i + 1]!r}"
+            ) from None
+        del argv[i : i + 2]
+    if not argv or argv[0] in ("-h", "--help"):
+        raise SystemExit(
+            "usage: python -m keystone_tpu observe trace <run-dir> "
+            "[--request ID] [--limit N]\n"
+            "<run-dir> is a directory containing spans.jsonl, or a base\n"
+            "KEYSTONE_OBSERVE_DIR (the newest run under it is rendered)"
+        )
+    try:
+        spans = read_spans(argv[0])
+    except OSError as e:
+        raise SystemExit(str(e)) from None
+    print(render_traces(spans, request=request, limit=limit))
+    print()
+    print("\n".join(render_goodput(goodput_summary(spans))))

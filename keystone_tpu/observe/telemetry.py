@@ -85,11 +85,13 @@ class StepLog:
             maxlen=_MAX_MEMORY_STEPS
         )
         self._lock = threading.Lock()
-        self._fh = None
+        self._sink: _events.JsonlSink | None = None
         if run_dir:
             try:
-                self._fh = open(  # noqa: SIM115 — held for the run
-                    os.path.join(run_dir, STEPS_FILE), "a", buffering=1
+                # size-rotated under KEYSTONE_OBSERVE_MAX_MB: a
+                # million-step run must not grow steps.jsonl unbounded
+                self._sink = _events.JsonlSink(
+                    os.path.join(run_dir, STEPS_FILE), "step telemetry"
                 )
             except OSError as e:
                 from keystone_tpu.core.logging import get_logger
@@ -109,10 +111,8 @@ class StepLog:
         rec.update(fields)
         with self._lock:
             self.records.append(rec)
-            if self._fh is not None:
-                self._fh = _events.write_record(
-                    self._fh, rec, "step telemetry"
-                )
+            if self._sink is not None:
+                self._sink.write(rec)
         return rec
 
     def step(
@@ -159,16 +159,27 @@ class StepLog:
             reg.timer("telemetry_step_seconds", source=source).observe(
                 float(wall_s)
             )
-        return self.record(source, **fields)
+        rec = self.record(source, **fields)
+        if source == "train":
+            # the anomaly monitor rides the live stream: NaN/spiked
+            # loss, step-time drift, HBM growth → `alert` events. Only
+            # reachable while a sink is active, so the telemetry-off
+            # hot path still pays exactly one global read.
+            from keystone_tpu.observe import health as _health
+
+            _health.get_monitor().note_step(
+                step=int(step),
+                loss=loss,
+                wall_s=wall_s,
+                hbm_peak_bytes=hbm_peak_bytes,
+            )
+        return rec
 
     def close(self) -> None:
         with self._lock:
-            if self._fh is not None:
-                try:
-                    self._fh.close()
-                except OSError:
-                    pass
-                self._fh = None
+            if self._sink is not None:
+                self._sink.close()
+                self._sink = None
 
 
 def active_step_log() -> StepLog | None:

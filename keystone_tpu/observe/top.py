@@ -19,6 +19,7 @@ to a file); otherwise the screen refreshes in place until Ctrl-C.
 from __future__ import annotations
 
 import json
+import math
 import os
 import sys
 import time
@@ -74,14 +75,24 @@ class Tail:
 
 
 def sparkline(values: list[float], width: int = _LOSS_WINDOW) -> str:
-    vals = [v for v in values[-width:] if isinstance(v, (int, float))]
-    if not vals:
+    # non-finite values (a NaN'd loss — exactly when someone is staring
+    # at the dashboard) render as the full bar instead of crashing the
+    # watch loop mid-incident
+    numeric = [v for v in values[-width:] if isinstance(v, (int, float))]
+    if not numeric:
         return ""
-    lo, hi = min(vals), max(vals)
+    vals = [v for v in numeric if math.isfinite(v)]
+    # an ALL-non-finite window (divergence that stuck) still renders —
+    # a vanished loss line mid-incident would be worse than any scale
+    lo, hi = (min(vals), max(vals)) if vals else (0.0, 0.0)
     span = (hi - lo) or 1.0
-    return "".join(
-        SPARK[int((v - lo) / span * (len(SPARK) - 1))] for v in vals
-    )
+    out = []
+    for v in numeric:
+        if not math.isfinite(v):
+            out.append(SPARK[-1])
+            continue
+        out.append(SPARK[int((v - lo) / span * (len(SPARK) - 1))])
+    return "".join(out)
 
 
 def _fmt_bytes(n: float | None) -> str:
@@ -121,6 +132,8 @@ def summarize(steps: list[dict], events: list[dict]) -> dict[str, Any]:
         "hbm_peak_bytes": None,
         "resilience": {},
         "cluster": {},
+        "alerts": {},
+        "last_alert": None,
         "plan_decisions": 0,
         "plan_streams": 0,
         "trace_windows": 0,
@@ -216,6 +229,10 @@ def summarize(steps: list[dict], events: list[dict]) -> dict[str, Any]:
         elif kind == "cluster":
             action = str(ev.get("action", "?"))
             out["cluster"][action] = out["cluster"].get(action, 0) + 1
+        elif kind == "alert":
+            action = str(ev.get("action", "?"))
+            out["alerts"][action] = out["alerts"].get(action, 0) + 1
+            out["last_alert"] = ev
         elif kind == "serve":
             sv = out["serve"]
             action = str(ev.get("action", "?"))
@@ -263,9 +280,14 @@ def render(state: dict[str, Any], run_dir: str) -> str:
         )
         spark = sparkline(state["losses"])
         if spark:
-            lo = min(state["losses"][-_LOSS_WINDOW:])
-            hi = max(state["losses"][-_LOSS_WINDOW:])
-            lines.append(f"loss  {spark}  [{lo:.3f} .. {hi:.3f}]")
+            finite = [
+                v
+                for v in state["losses"][-_LOSS_WINDOW:]
+                if isinstance(v, (int, float)) and math.isfinite(v)
+            ] or [0.0]
+            lines.append(
+                f"loss  {spark}  [{min(finite):.3f} .. {max(finite):.3f}]"
+            )
     else:
         lines.append("steps (no step telemetry yet)")
     lines.append("")
@@ -290,6 +312,20 @@ def render(state: dict[str, Any], run_dir: str) -> str:
         if not state["devices"]:
             lines.append(f"  peak {_fmt_bytes(state['hbm_peak_bytes'])}")
         lines.append("")
+    if state.get("alerts"):
+        pairs = "  ".join(
+            f"{k}={v}" for k, v in sorted(state["alerts"].items())
+        )
+        lines.append(f"ALERTS: {pairs}")
+        last = state.get("last_alert") or {}
+        detail = "  ".join(
+            f"{k}={v}"
+            for k, v in last.items()
+            if k not in ("event", "ts", "run", "phase", "action")
+            and v is not None
+        )
+        if detail:
+            lines.append(f"  last: {last.get('action', '?')}  {detail}")
     if state["resilience"]:
         pairs = "  ".join(
             f"{k}={v}" for k, v in sorted(state["resilience"].items())

@@ -367,7 +367,14 @@ def rollup_metrics(
     if nprocs == 1:
         dumps: list[dict] | None = [local]
     else:
-        dumps = _gather_dumps(json.dumps(local), pid, nprocs, timeout_s)
+        # the gather is a real cross-host collective: its wall is
+        # classified (bucket="collective") in the goodput report
+        from keystone_tpu.observe import spans as _spans
+
+        with _spans.span(
+            "multihost.rollup_gather", bucket="collective", hosts=nprocs
+        ):
+            dumps = _gather_dumps(json.dumps(local), pid, nprocs, timeout_s)
         if dumps is None:
             return None
     merged = {

@@ -54,6 +54,7 @@ from keystone_tpu.core.pipeline import (
 )
 from keystone_tpu.observe import events as _events
 from keystone_tpu.observe import metrics as _metrics
+from keystone_tpu.observe import spans as _spans
 from keystone_tpu.observe import telemetry as _telemetry
 from keystone_tpu.plan.ir import Plan, PlanNode
 
@@ -186,6 +187,7 @@ def _run_chain(
     chunked when the plan chose a chunk size. ``own_input`` marks ``data``
     as a planner-created intermediate that may be freed once consumed."""
     reg = _metrics.get_registry()
+    span_log = _spans.active_span_log()  # once per chain, not per segment
     out = data
     owned = own_input
     for seg in _segments(chain):
@@ -201,58 +203,81 @@ def _run_chain(
                 seg[0]._chunk_probe_ok = chunk_ok
         else:
             chunk_ok = False
-        if chunk_ok:
-            from keystone_tpu.parallel.mesh import data_axis_size
-
-            sharding = _data_sharding(plan)
-            shards = data_axis_size(plan.mesh)
-            # a chunk that doesn't divide over the data axis can't form
-            # even shard shapes — the planner rounds, this guards
-            if sharding is not None and plan.chunk_size % shards:
-                sharding = None
-            # live telemetry: one steps.jsonl record per chunked segment
-            # stream, plus the staged-depth / in-flight gauges the
-            # dashboard reads. One global read when no sink is active.
-            steplog = _telemetry.active_step_log()
-            t0 = time.perf_counter()
-            out = apply_in_chunks(
-                lambda b, p=seg_pipe: jit_apply(p, b),
-                out,
-                plan.chunk_size,
-                inflight=max(plan.prefetch, 0),
-                sharding=sharding,
-                stage_depth=plan.stage_depth,
-                shard_multiple=shards if sharding is not None else None,
-            )
-            reg.counter("plan_chunked_executions").inc()
-            if sharding is not None:
-                reg.counter("plan_shard_dispatches").inc()
-            if steplog is not None:
-                reg.gauge("plan_inflight").set(float(max(plan.prefetch, 0)))
-                reg.gauge("plan_stage_depth").set(float(plan.stage_depth))
-                wall = time.perf_counter() - t0
-                rows = int(getattr(prev, "shape", (0,))[0] or 0)
-                flops = sum(pn.cost.flops for pn in seg) * rows
-                steplog.step(
-                    step=next(_stream_seq),
-                    source="plan",
-                    wall_s=wall,
-                    flops=flops or None,
-                    rows=rows,
-                    rows_per_s=round(rows / wall, 3) if wall else None,
-                    chunks=-(-rows // plan.chunk_size) if rows else 0,
-                    chunk_size=plan.chunk_size,
-                    stage_depth=plan.stage_depth,
-                    inflight=max(plan.prefetch, 0),
-                )
-        else:
-            out = jit_apply(seg_pipe, out)
+        # one span per executed segment, ambient for everything it
+        # dispatches: a chunked segment's staging h2d / device-wait
+        # spans nest under it (so the segment is structural — its time
+        # lives in its children), an unchunked one IS the compute
+        seg_span = _spans.span(
+            "plan.segment",
+            log=span_log,
+            bucket=None if chunk_ok else "compute",
+            nodes=len(seg),
+            chunked=bool(chunk_ok),
+            head=seg[0].label,
+        )
+        with seg_span:
+            out = _exec_segment(seg, seg_pipe, out, plan, chunk_ok, reg)
         if seg[-1].materialize or isinstance(seg[-1].op, Cacher):
-            out = jax.block_until_ready(out)
+            with _spans.span(
+                "plan.materialize", log=span_log, bucket="wait_device"
+            ):
+                out = jax.block_until_ready(out)
         reg.counter("plan_segments_executed").inc()
         if owned:
             _free(prev, keep=out)
         owned = True
+    return out
+
+
+def _exec_segment(seg, seg_pipe, data, plan: Plan, chunk_ok: bool, reg):
+    """Execute ONE segment body (split from :func:`_run_chain` so the
+    per-segment span brackets exactly the execution — materialization,
+    counters, and freeing stay with the chain loop)."""
+    if not chunk_ok:
+        return jit_apply(seg_pipe, data)
+    from keystone_tpu.parallel.mesh import data_axis_size
+
+    sharding = _data_sharding(plan)
+    shards = data_axis_size(plan.mesh)
+    # a chunk that doesn't divide over the data axis can't form
+    # even shard shapes — the planner rounds, this guards
+    if sharding is not None and plan.chunk_size % shards:
+        sharding = None
+    # live telemetry: one steps.jsonl record per chunked segment
+    # stream, plus the staged-depth / in-flight gauges the
+    # dashboard reads. One global read when no sink is active.
+    steplog = _telemetry.active_step_log()
+    t0 = time.perf_counter()
+    out = apply_in_chunks(
+        lambda b, p=seg_pipe: jit_apply(p, b),
+        data,
+        plan.chunk_size,
+        inflight=max(plan.prefetch, 0),
+        sharding=sharding,
+        stage_depth=plan.stage_depth,
+        shard_multiple=shards if sharding is not None else None,
+    )
+    reg.counter("plan_chunked_executions").inc()
+    if sharding is not None:
+        reg.counter("plan_shard_dispatches").inc()
+    if steplog is not None:
+        reg.gauge("plan_inflight").set(float(max(plan.prefetch, 0)))
+        reg.gauge("plan_stage_depth").set(float(plan.stage_depth))
+        wall = time.perf_counter() - t0
+        rows = int(getattr(data, "shape", (0,))[0] or 0)
+        flops = sum(pn.cost.flops for pn in seg) * rows
+        steplog.step(
+            step=next(_stream_seq),
+            source="plan",
+            wall_s=wall,
+            flops=flops or None,
+            rows=rows,
+            rows_per_s=round(rows / wall, 3) if wall else None,
+            chunks=-(-rows // plan.chunk_size) if rows else 0,
+            chunk_size=plan.chunk_size,
+            stage_depth=plan.stage_depth,
+            inflight=max(plan.prefetch, 0),
+        )
     return out
 
 
@@ -482,9 +507,15 @@ def serve_stream(
     reg = _metrics.get_registry()
     steplog = _telemetry.active_step_log()
     t0 = time.perf_counter()
-    out = apply_in_chunks(
-        dispatch, rows, bucket, inflight=inflight, stage_depth=stage_depth
-    )
+    # ambient span: the staging engine's h2d / device-wait spans nest
+    # under the stream (and the stream under the serve.batch span when
+    # the micro-batcher dispatched us)
+    with _spans.span(
+        "serve.stream", rows=int(rows.shape[0]), bucket_size=bucket
+    ):
+        out = apply_in_chunks(
+            dispatch, rows, bucket, inflight=inflight, stage_depth=stage_depth
+        )
     reg.counter("serve_stream_batches").inc()
     if steplog is not None:
         wall = time.perf_counter() - t0

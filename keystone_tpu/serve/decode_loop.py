@@ -40,6 +40,7 @@ from keystone_tpu.models.lm.decode import (
     prefill,
 )
 from keystone_tpu.observe import metrics as _metrics
+from keystone_tpu.observe import spans as _spans
 from keystone_tpu.observe import telemetry as _telemetry
 from keystone_tpu.serve.queue import ServeFuture
 
@@ -97,14 +98,23 @@ def _merge_slot(pool: KVCache, one: KVCache, slot):
 
 
 class _Sequence:
-    __slots__ = ("rid", "tokens", "remaining", "future", "submitted")
+    __slots__ = (
+        "rid", "tokens", "remaining", "future", "submitted", "ctx",
+        "gen_ctx",
+    )
 
-    def __init__(self, rid, remaining: int, future: ServeFuture):
+    def __init__(self, rid, remaining: int, future: ServeFuture, ctx=None):
         self.rid = rid
         self.tokens: list[int] = []
         self.remaining = remaining
         self.future = future
         self.submitted = time.perf_counter()
+        # ctx: the submitter's span context (captured at submit — the
+        # decode worker thread has no ambient context); gen_ctx: the
+        # pre-allocated slot-span ids so the prefill recorded at admit
+        # parents on the generation span recorded at retire
+        self.ctx = ctx
+        self.gen_ctx = None
 
 
 class DecodeLoop:
@@ -227,7 +237,7 @@ class DecodeLoop:
             )
             return fut
         with self._work:
-            self._queue.append((prompt, max_new, rid, fut))
+            self._queue.append((prompt, max_new, rid, fut, _spans.current()))
             _metrics.get_registry().counter("serve_decode_requests").inc()
             self._work.notify()
         return fut
@@ -246,7 +256,7 @@ class DecodeLoop:
                 )
                 if free is None:
                     return
-                prompt, max_new, rid, fut = self._queue.popleft()
+                prompt, max_new, rid, fut, ctx = self._queue.popleft()
             width = next(
                 (w for w in self.prefill_buckets if w >= prompt.size),
                 self.prefill_buckets[-1],
@@ -254,6 +264,8 @@ class DecodeLoop:
             width = max(width, prompt.size)
             padded = np.zeros((1, width), np.int32)
             padded[0, : prompt.size] = prompt
+            span_log = _spans.active_span_log()
+            t_pre0 = time.perf_counter()
             logits, one = _jit_prefill(
                 self.model,
                 jnp.asarray(padded),
@@ -267,7 +279,22 @@ class DecodeLoop:
                     self.top_p,
                 )[0]
             )
-            seq = _Sequence(rid, max_new, fut)
+            seq = _Sequence(rid, max_new, fut, ctx=ctx)
+            if span_log is not None:
+                # slot-span scaffolding: the generation span's ids are
+                # allocated NOW so the prefill can parent on it, but the
+                # span itself is recorded at retire (when its wall is
+                # known)
+                seq.gen_ctx = _spans.make_context(ctx)
+                span_log.record_span(
+                    "decode.prefill",
+                    wall_s=time.perf_counter() - t_pre0,
+                    bucket="compute",
+                    parent=seq.gen_ctx,
+                    rid=rid,
+                    width=width,
+                    slot=free,
+                )
             seq.tokens.append(tok0)
             seq.remaining = max_new - 1
             self.tokens_out += 1
@@ -287,6 +314,7 @@ class DecodeLoop:
         if seq is not None:
             _metrics.get_registry().counter("serve_decode_finished").inc()
             seq.future.set_result(np.asarray(seq.tokens, np.int32))
+            wall = time.perf_counter() - seq.submitted
             # one source="serve" stream row per finished generation —
             # the serving panel's decode line (one global read when no
             # telemetry sink is active)
@@ -296,9 +324,25 @@ class DecodeLoop:
                     "serve",
                     kind="decode",
                     tokens=len(seq.tokens),
-                    wall_s=round(time.perf_counter() - seq.submitted, 6),
+                    wall_s=round(wall, 6),
                     slots=self.slots,
                 )
+            # the slot span: submit→retire wall of this generation,
+            # with the admit-time prefill as its child (gen_ctx was
+            # pre-allocated at admit; structural — the prefill and the
+            # pooled steps carry the classified time)
+            if seq.gen_ctx is not None:
+                span_log = _spans.active_span_log()
+                if span_log is not None:
+                    span_log.record_span(
+                        "serve.generate",
+                        wall_s=wall,
+                        parent=seq.ctx,
+                        ctx=seq.gen_ctx,
+                        rid=seq.rid,
+                        tokens=len(seq.tokens),
+                        slot=slot,
+                    )
 
     def step(self) -> int:
         """Admit + one pooled decode step. Returns the number of active

@@ -31,7 +31,9 @@ from typing import Any, Callable, Sequence
 import jax
 import numpy as np
 
+from keystone_tpu.observe import health as _health
 from keystone_tpu.observe import metrics as _metrics
+from keystone_tpu.observe import spans as _spans
 from keystone_tpu.observe import telemetry as _telemetry
 
 ENV_DEADLINE_MS = "KEYSTONE_SERVE_DEADLINE_MS"
@@ -107,6 +109,10 @@ class _Pending:
     enqueued: float  # clock() at submit
     future: ServeFuture
     rid: Any = None
+    # the submitter's span context, captured at submit: contextvars do
+    # NOT flow into the already-running batcher thread, so the request→
+    # batch causal link must ride the pending record itself
+    ctx: Any = None
 
 
 class MicroBatcher:
@@ -177,6 +183,7 @@ class MicroBatcher:
                     enqueued=self.clock(),
                     future=fut,
                     rid=rid,
+                    ctx=_spans.current(),
                 )
             )
             reg.counter("serve_requests").inc()
@@ -239,6 +246,11 @@ class MicroBatcher:
         a dead thread would hang every pending and future request while
         /healthz still answered ok."""
         reg = _metrics.get_registry()
+        # span wiring looked up ONCE per batch (not per request): the
+        # per-request marginal cost with no sink stays at the submit
+        # path's zero global reads
+        span_log = _spans.active_span_log()
+        t_disp0 = self.clock()
         t0 = time.perf_counter()
         try:
             rows = np.concatenate([p.rows for p in batch], axis=0)
@@ -248,25 +260,109 @@ class MicroBatcher:
             if n < bucket:
                 pad = np.zeros((bucket - n, *rows.shape[1:]), rows.dtype)
                 padded = np.concatenate([rows, pad], axis=0)
-            out = self.dispatch(padded)
+            # the batch span is the ambient context while the model
+            # runs, so plan-segment / staging spans from the dispatch
+            # nest under ONE batch-level trace (requests link to it via
+            # their dispatch spans' batch_trace attr)
+            with _spans.span(
+                "serve.batch",
+                log=span_log,
+                requests=len(batch),
+                bucket_size=bucket,
+                rows=n,
+            ) as batch_ctx:
+                out = self.dispatch(padded)
+                # force HERE, not in each requester's np.asarray: an
+                # async jax dispatch returns un-forced arrays, which
+                # would resolve futures whose device work hasn't run —
+                # the dispatch wall, the device-compute span, and the
+                # deadline-miss accounting below would all silently
+                # under-report while requesters paid the wait blind
+                out = jax.block_until_ready(out)
+            # materialize every per-request slice inside the SAME error
+            # fan-out: the slices are themselves lazy jax work (an OOM
+            # here must fail these futures, not kill the batch thread),
+            # and un-forced results would make the requester pay a wait
+            # no timer or span sees
+            off = 0
+            results = []
+            for p in batch:
+                res = jax.tree_util.tree_map(
+                    lambda a, o=off, m=p.n: a[o : o + m], out
+                )
+                off += p.n
+                results.append(jax.block_until_ready(res))
         except BaseException as e:  # noqa: BLE001 — fan the failure out
             for p in batch:
                 p.future.set_exception(e)
             reg.counter("serve_dispatch_errors").inc()
             return
         wall = time.perf_counter() - t0
-        off = 0
         now = self.clock()
-        for p in batch:
-            sl = p.future
-            res = jax.tree_util.tree_map(
-                lambda a, o=off, m=p.n: a[o : o + m], out
-            )
-            off += p.n
+        # resolve futures FIRST: everything after this line is
+        # observability bookkeeping and must never stand between a
+        # computed result and its waiting requester
+        for p, res in zip(batch, results):
             reg.timer("serve_request_seconds").observe(
                 max(now - p.enqueued, 0.0)
             )
-            sl.set_result(res)
+            p.future.set_result(res)
+        # SLO accounting: a request whose queue wait already exceeded
+        # the deadline when its batch shipped is a deadline miss — the
+        # batcher never *plans* one, but an overloaded dispatch queue
+        # still produces them, and the health monitor alerts on the rate
+        misses = sum(
+            1 for p in batch if t_disp0 - p.enqueued > self.deadline_s
+        )
+        if misses:
+            reg.counter("serve_deadline_miss").inc(misses)
+        _health.get_monitor().note_dispatch(
+            requests=len(batch), misses=misses
+        )
+        if span_log is not None:
+            for p in batch:
+                qw_ctx = span_log.record_span(
+                    "serve.queue_wait",
+                    wall_s=max(t_disp0 - p.enqueued, 0.0),
+                    bucket="queue",
+                    parent=p.ctx,
+                    rid=p.rid,
+                )
+                d_ctx = span_log.record_span(
+                    "serve.dispatch",
+                    wall_s=max(now - t_disp0, 0.0),
+                    parent=p.ctx,
+                    # a bare-batcher submit (no request span) still gets
+                    # ONE coherent trace per request, not one per span
+                    trace=qw_ctx.trace if p.ctx is None else None,
+                    rid=p.rid,
+                    requests=len(batch),
+                    bucket_size=bucket,
+                    batch_trace=(
+                        batch_ctx.trace if batch_ctx is not None else None
+                    ),
+                )
+                # structural in the request's tree (no bucket): the
+                # batch-level serve.compute span below carries the
+                # classified wall ONCE — a bucketed copy per request
+                # would count the same device time batch-fill times
+                # over in the goodput shares
+                span_log.record_span(
+                    "serve.device_compute",
+                    wall_s=wall,
+                    parent=d_ctx,
+                )
+            span_log.record_span(
+                "serve.compute",
+                wall_s=wall,
+                # an oversized batch streamed through serve_stream,
+                # whose staging children already classified this wall
+                # as wait_host/wait_device — bucketing it again here
+                # would count the same seconds twice in the goodput
+                # shares. Bucket only the single-executable path.
+                bucket="compute" if n <= self.buckets[-1] else None,
+                parent=batch_ctx,
+            )
         reg.counter("serve_batches").inc()
         reg.counter("serve_pad_rows").inc(max(bucket - n, 0))
         fill = n / bucket if bucket else 0.0

@@ -53,7 +53,9 @@ import numpy as np
 
 from keystone_tpu.core.logging import get_logger
 from keystone_tpu.observe import events as _events
+from keystone_tpu.observe import health as _health
 from keystone_tpu.observe import metrics as _metrics
+from keystone_tpu.observe import spans as _spans
 from keystone_tpu.resilience import faults as _faults
 
 logger = get_logger("keystone_tpu.serve.server")
@@ -174,18 +176,54 @@ class ServeApp:
     def predict(self, rows) -> np.ndarray:
         if self.batcher is None:
             raise ValueError("no pipeline exported on this server")
-        rid = self.admit()
-        with self._bracket():
-            fut = self.batcher.submit(rows, rid=rid)
-            return np.asarray(fut.result(timeout=_request_timeout_s()))
+        t0 = time.perf_counter()
+        try:
+            rid = self.admit()
+        except OverloadShed:
+            _health.get_monitor().note_request(
+                time.perf_counter() - t0, shed=True
+            )
+            raise
+        # the request's root span: queue-wait / dispatch / device spans
+        # recorded by the batcher (its thread) parent on this context.
+        # ONE global read per request with no sink active — the hot-path
+        # contract the spans test pins.
+        try:
+            with self._bracket(), _spans.span(
+                "serve.request", rid=rid, kind="predict"
+            ):
+                fut = self.batcher.submit(rows, rid=rid)
+                out = np.asarray(fut.result(timeout=_request_timeout_s()))
+        finally:
+            # finally, not on success only: a timed-out request is by
+            # definition the slowest one — the monitor MUST see it
+            _health.get_monitor().note_request(
+                time.perf_counter() - t0, rid=rid
+            )
+        return out
 
     def generate(self, prompt, max_new: int | None = None) -> np.ndarray:
         if self.loop is None:
             raise ValueError("no LM decode pool on this server")
-        rid = self.admit()
-        with self._bracket():
-            fut = self.loop.submit(prompt, max_new=max_new, rid=rid)
-            return np.asarray(fut.result(timeout=_request_timeout_s()))
+        t0 = time.perf_counter()
+        try:
+            rid = self.admit()
+        except OverloadShed:
+            _health.get_monitor().note_request(
+                time.perf_counter() - t0, shed=True
+            )
+            raise
+        try:
+            with self._bracket(), _spans.span(
+                "serve.request", rid=rid, kind="generate"
+            ):
+                fut = self.loop.submit(prompt, max_new=max_new, rid=rid)
+                out = np.asarray(fut.result(timeout=_request_timeout_s()))
+        finally:
+            _health.get_monitor().note_request(
+                time.perf_counter() - t0, rid=rid
+            )
+        return out
 
     def health(self) -> dict:
         reg = _metrics.get_registry()
@@ -232,9 +270,12 @@ def _handler_for(app: ServeApp):
             pass
 
         def _send(self, code: int, payload: dict) -> None:
-            body = json.dumps(payload).encode()
+            self._send_text(code, json.dumps(payload), "application/json")
+
+        def _send_text(self, code: int, text: str, content_type: str) -> None:
+            body = text.encode()
             self.send_response(code)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
@@ -243,8 +284,18 @@ def _handler_for(app: ServeApp):
             if self.path == "/healthz":
                 return self._send(200, app.health())
             if self.path == "/metrics":
-                return self._send(
-                    200, {"metrics": _metrics.get_registry().snapshot()}
+                # Prometheus text exposition by default (what a scraper
+                # expects); the JSON snapshot stays available behind
+                # Accept: application/json for humans and the tests
+                accept = self.headers.get("Accept") or ""
+                if "application/json" in accept:
+                    return self._send(
+                        200, {"metrics": _metrics.get_registry().snapshot()}
+                    )
+                return self._send_text(
+                    200,
+                    _metrics.get_registry().to_prometheus(),
+                    "text/plain; version=0.0.4; charset=utf-8",
                 )
             return self._send(
                 404,

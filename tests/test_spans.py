@@ -1,0 +1,733 @@
+"""PR-9 observability tests: end-to-end span tracing (propagation
+across the micro-batcher worker thread, the staging thread, and the
+decode loop), the request critical-path acceptance, goodput
+summaries, the rolling-baseline anomaly monitor and its deterministic
+fault drills, size-based stream rotation, the event-schema drift
+check, Prometheus exposition, and the ``observe trace`` CLI."""
+
+import json
+import math
+import os
+import pathlib
+import re
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from keystone_tpu.observe import events, health, metrics
+from keystone_tpu.observe import spans as spans_mod
+from keystone_tpu.resilience import faults
+from keystone_tpu.serve.queue import MicroBatcher
+
+
+class Clock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+class FakeExported:
+    """A serve dispatch stub shaped like ExportedApply: buckets attr +
+    row-indexed __call__ (optionally with a deliberate device wall)."""
+
+    buckets = (8,)
+
+    def __init__(self, wall_s: float = 0.0, buckets=(8,)):
+        self.wall_s = wall_s
+        self.buckets = tuple(buckets)
+
+    def __call__(self, batch):
+        if self.wall_s:
+            time.sleep(self.wall_s)
+        return np.asarray(batch) * 2.0
+
+
+def _rows(n: int, d: int = 3) -> np.ndarray:
+    return np.ones((n, d), np.float32)
+
+
+# ---------------------------------------------------------------------------
+# span primitives
+
+
+def test_span_nesting_trace_and_parent_ids(tmp_path):
+    with events.run(str(tmp_path)) as log:
+        with spans_mod.span("outer", kind="unit") as octx:
+            assert spans_mod.current() == octx
+            with spans_mod.span("inner", bucket="compute") as ictx:
+                assert ictx.trace == octx.trace
+            assert spans_mod.current() == octx
+        assert spans_mod.current() is None
+        run_dir = log.run_dir
+        sl = spans_mod.active_span_log()
+    recs = spans_mod.read_spans(run_dir)
+    by_name = {r["name"]: r for r in recs}
+    assert by_name["inner"]["parent"] == octx.span
+    assert by_name["inner"]["trace"] == octx.trace == by_name["outer"]["trace"]
+    assert by_name["inner"]["bucket"] == "compute"
+    assert "bucket" not in by_name["outer"]  # structural
+    assert by_name["outer"]["wall_s"] >= by_name["inner"]["wall_s"] >= 0
+    # the run's sink closes with the event log
+    assert sl is not None and sl._sink is None
+
+
+def test_span_records_failed_status(tmp_path):
+    with events.run(str(tmp_path)) as log:
+        with pytest.raises(ValueError):
+            with spans_mod.span("doomed"):
+                raise ValueError("boom")
+        run_dir = log.run_dir
+    recs = spans_mod.read_spans(run_dir)
+    assert recs[0]["name"] == "doomed" and recs[0]["status"] == "failed"
+
+
+def test_request_hot_path_exactly_one_global_read_no_sink(monkeypatch):
+    """Acceptance: with no sink active the request hot path pays exactly
+    ONE global read — the request span gate. Submission costs zero, and
+    the batch dispatch adds a constant two reads per BATCH (step + span
+    log lookups), never per request."""
+    assert events.active() is None  # suite invariant
+    health.reset_monitor()
+    reads: list[int] = []
+    monkeypatch.setattr(events, "active", lambda: reads.append(1) or None)
+
+    def boom(self, *a, **k):
+        raise AssertionError("span/step log built with no sink active")
+
+    monkeypatch.setattr(spans_mod.SpanLog, "__init__", boom)
+
+    clock = Clock()
+    mb = MicroBatcher(
+        FakeExported(), buckets=(8,), deadline_ms=10.0, clock=clock,
+        start=False,
+    )
+    futs = []
+    for rid in range(4):
+        # what ServeApp.predict does per request: one span gate + submit
+        with spans_mod.span("serve.request", rid=rid):
+            futs.append(mb.submit(_rows(1), rid=rid))
+    assert len(reads) == 4  # exactly one global read per request
+    clock.t = 1.0
+    assert mb.pump(now=1.0) == 1
+    assert len(reads) == 4 + 2  # two more per BATCH, not per request
+    assert all(f.done() for f in futs)
+
+
+# ---------------------------------------------------------------------------
+# propagation across thread boundaries
+
+
+def test_batcher_spans_cross_worker_thread_scheduler_form(tmp_path):
+    """Deterministic (injected clock, no threads): each request's spans
+    land in ITS trace even though _run_batch runs outside the request
+    context, and the dispatch spans link to one shared batch trace."""
+    clock = Clock()
+    with events.run(str(tmp_path)) as log:
+        mb = MicroBatcher(
+            FakeExported(), buckets=(8,), deadline_ms=10.0, clock=clock,
+            start=False,
+        )
+        ctxs = []
+        for rid in range(2):
+            with spans_mod.span("serve.request", rid=rid) as ctx:
+                mb.submit(_rows(2), rid=rid)
+                ctxs.append(ctx)
+        clock.t = 0.010
+        assert mb.pump(now=0.010) == 1
+        run_dir = log.run_dir
+    recs = spans_mod.read_spans(run_dir)
+    for rid, ctx in enumerate(ctxs):
+        mine = [r for r in recs if r.get("trace") == ctx.trace]
+        names = {r["name"] for r in mine}
+        assert {"serve.request", "serve.queue_wait", "serve.dispatch",
+                "serve.device_compute"} <= names
+        qw = next(r for r in mine if r["name"] == "serve.queue_wait")
+        assert qw["parent"] == ctx.span and qw["bucket"] == "queue"
+        disp = next(r for r in mine if r["name"] == "serve.dispatch")
+        assert disp["parent"] == ctx.span and disp["requests"] == 2
+    # both dispatches link to the SAME batch-level trace, which holds
+    # the serve.batch span the model actually ran under
+    batch_traces = {
+        r["batch_trace"] for r in recs if r["name"] == "serve.dispatch"
+    }
+    assert len(batch_traces) == 1
+    batch = [r for r in recs if r.get("trace") in batch_traces]
+    assert any(r["name"] == "serve.batch" for r in batch)
+    # the classified device wall is counted ONCE per batch (the
+    # serve.compute span) — the per-request device_compute copies are
+    # structural, so a full bucket can't inflate the goodput shares
+    # batch-fill times over
+    compute = [r for r in recs if r.get("bucket") == "compute"]
+    assert len(compute) == 1 and compute[0]["name"] == "serve.compute"
+    assert all(
+        "bucket" not in r
+        for r in recs
+        if r["name"] == "serve.device_compute"
+    )
+
+
+def test_batcher_slice_failure_fans_out_not_thread_death():
+    """A failure AFTER dispatch (while materializing per-request
+    slices) must fail the batch's futures like a dispatch failure —
+    never escape and kill the batching thread."""
+    clock = Clock()
+    mb = MicroBatcher(
+        lambda batch: 1.0,  # scalar result: per-request slicing raises
+        buckets=(8,), deadline_ms=10.0, clock=clock, start=False,
+    )
+    f1 = mb.submit(_rows(2))
+    f2 = mb.submit(_rows(1))
+    clock.t = 0.010
+    assert mb.pump(now=0.010) == 1  # does not raise
+    for f in (f1, f2):
+        with pytest.raises(TypeError):
+            f.result(0)
+
+
+def test_staging_spans_cross_staging_thread(tmp_path):
+    from keystone_tpu.core.staging import run_staged
+
+    chunks = [(np.full((4, 2), i, np.float32), 4) for i in range(4)]
+    with events.run(str(tmp_path)) as log:
+        with spans_mod.span("plan.segment") as octx:
+            outs = list(
+                run_staged(iter(chunks), lambda x: x * 2, stage_depth=2)
+            )
+        run_dir = log.run_dir
+    assert len(outs) == 4
+    recs = spans_mod.read_spans(run_dir)
+    h2d = [r for r in recs if r["name"] == "staging.h2d"]
+    waits = [r for r in recs if r["name"] == "staging.wait_device"]
+    assert len(h2d) == 4 and len(waits) == 4
+    # the worker thread's placements parent on the consumer's ambient
+    # span, captured at stream creation
+    assert all(
+        r["trace"] == octx.trace and r["parent"] == octx.span
+        and r["bucket"] == "wait_host" and r["bytes"] > 0
+        for r in h2d
+    )
+    assert all(
+        r["trace"] == octx.trace and r["bucket"] == "wait_device"
+        for r in waits
+    )
+
+
+def test_plan_executor_segment_spans_nest_staging(tmp_path):
+    import jax.numpy as jnp
+
+    from keystone_tpu.core.pipeline import FnTransformer, Pipeline
+    from keystone_tpu.plan.executor import run_plan
+    from keystone_tpu.plan.ir import Plan, chain_from
+
+    pipe = Pipeline.of(FnTransformer(fn=lambda x: x * 2.0))
+    x = np.ones((32, 4), np.float32)
+    expect = np.asarray(pipe(jnp.asarray(x)))
+    with events.run(str(tmp_path)) as log:
+        got = np.asarray(
+            run_plan(Plan(prefix=chain_from(pipe), chunk_size=8), x)
+        )
+        run_dir = log.run_dir
+    assert np.array_equal(got, expect)
+    recs = spans_mod.read_spans(run_dir)
+    seg = [r for r in recs if r["name"] == "plan.segment"]
+    assert seg and seg[0]["chunked"] is True and "bucket" not in seg[0]
+    children = [r for r in recs if r.get("parent") == seg[0]["span"]]
+    names = {r["name"] for r in children}
+    assert {"staging.h2d", "staging.wait_device"} <= names
+
+
+def test_decode_loop_slot_spans(tmp_path):
+    import jax
+
+    from keystone_tpu.models.lm.model import TransformerLM
+    from keystone_tpu.serve.decode_loop import DecodeLoop
+
+    model = TransformerLM.create(
+        jax.random.key(0), vocab=32, max_seq=32, dim=32, depth=1,
+        num_heads=2,
+    )
+    with events.run(str(tmp_path)) as log:
+        loop = DecodeLoop(
+            model, slots=2, s_max=32, max_new=4, prefill_buckets=(8,)
+        )
+        with spans_mod.span("serve.request", rid=7) as rctx:
+            fut = loop.submit([1, 2, 3], rid=7)
+        while not fut.done():
+            loop.step()
+        out = fut.result(timeout=0)
+        run_dir = log.run_dir
+    assert out.shape[0] == 4
+    recs = spans_mod.read_spans(run_dir)
+    gen = next(r for r in recs if r["name"] == "serve.generate")
+    pre = next(r for r in recs if r["name"] == "decode.prefill")
+    # request → generation → prefill, across the decode schedule
+    assert gen["trace"] == rctx.trace and gen["parent"] == rctx.span
+    assert pre["trace"] == rctx.trace and pre["parent"] == gen["span"]
+    assert gen["tokens"] == 4 and gen["rid"] == 7
+
+
+# ---------------------------------------------------------------------------
+# the /predict acceptance: span tree vs measured wall
+
+
+def test_predict_span_tree_critical_path_within_10pct(tmp_path):
+    """Acceptance: a served /predict request's span tree covers
+    queue-wait, dispatch, and device-compute, and its critical-path sum
+    is within 10% of the measured request wall."""
+    from keystone_tpu.serve.server import ServeApp
+
+    health.reset_monitor()
+    with events.run(str(tmp_path)) as log:
+        app = ServeApp(
+            exported=FakeExported(wall_s=0.02), deadline_ms=150.0
+        )
+        t0 = time.perf_counter()
+        out = app.predict(_rows(2))
+        wall = time.perf_counter() - t0
+        app.shutdown()
+        run_dir = log.run_dir
+    assert out.shape == (2, 3)
+    recs = spans_mod.read_spans(run_dir)
+    trees = spans_mod.build_trees(recs)
+    req = None
+    for roots in trees.values():
+        for r in roots:
+            if r["rec"]["name"] == "serve.request":
+                req = roots
+    assert req is not None
+    names = {n["rec"]["name"] for n in spans_mod._walk(req)}
+    assert {"serve.request", "serve.queue_wait", "serve.dispatch",
+            "serve.device_compute"} <= names
+    cp = spans_mod.trace_critical_path(req)
+    assert wall > 0 and abs(cp - wall) / wall < 0.10, (cp, wall)
+
+
+# ---------------------------------------------------------------------------
+# goodput
+
+
+def test_goodput_summary_buckets_and_critical_path():
+    sl = spans_mod.SpanLog()  # memory-only
+    root = sl.record_span("train.step", wall_s=1.0, step=1)
+    sl.record_span(
+        "train.host_batch", wall_s=0.25, bucket="wait_host", parent=root
+    )
+    sl.record_span(
+        "train.compute", wall_s=0.75, bucket="compute", parent=root
+    )
+    g = spans_mod.goodput_summary(list(sl.records))
+    assert g["total_s"] == pytest.approx(1.0)
+    assert g["buckets"]["compute"]["share"] == pytest.approx(0.75)
+    assert g["buckets"]["wait_host"]["share"] == pytest.approx(0.25)
+    # the structural root is not a bucket, but IS the critical path
+    assert g["critical_path_s"] == pytest.approx(1.0)
+    assert g["traces"] == 1 and g["spans"] == 3
+
+
+# ---------------------------------------------------------------------------
+# anomaly monitor units (injected clock, zero sleeps)
+
+
+def _cfg(**kw) -> health.HealthConfig:
+    base = dict(
+        baseline_steps=4, window=8, step_p95_factor=2.0,
+        loss_spike_factor=3.0, loss_warmup=3, hbm_growth_factor=1.5,
+        deadline_miss_rate=0.5, shed_rate=0.05, rate_min_requests=10,
+        cooldown_steps=0, cooldown_s=30.0, slow_request_s=0.01,
+    )
+    base.update(kw)
+    return health.HealthConfig(**base)
+
+
+def test_health_nan_and_spike_alerts():
+    mon = health.HealthMonitor(_cfg(), emit=False)
+    mon.note_step(step=1, loss=float("nan"))
+    assert [a["kind"] for a in mon.alerts] == ["train.nan_loss"]
+    for i in range(2, 8):
+        mon.note_step(step=i, loss=1.0)
+    mon.note_step(step=8, loss=10.0)  # > 3x the EMA
+    assert [a["kind"] for a in mon.alerts][-1] == "train.loss_spike"
+
+
+def test_health_step_time_drift_vs_frozen_baseline():
+    mon = health.HealthMonitor(_cfg(), emit=False)
+    # step 1 (compile) is excluded from the baseline by design
+    mon.note_step(step=1, wall_s=9.0)
+    for i in range(2, 6):  # steps 2..5 freeze the baseline at ~10 ms
+        mon.note_step(step=i, wall_s=0.010)
+    assert not mon.alerts
+    for i in range(6, 14):  # sustained 5x drift
+        mon.note_step(step=i, wall_s=0.050)
+    kinds = [a["kind"] for a in mon.alerts]
+    assert "train.step_time_drift" in kinds
+
+
+def test_health_hbm_growth_ratchets():
+    mon = health.HealthMonitor(_cfg(), emit=False)
+    mon.note_step(step=1, hbm_peak_bytes=100)
+    mon.note_step(step=2, hbm_peak_bytes=120)  # < 1.5x: quiet
+    assert not mon.alerts
+    mon.note_step(step=3, hbm_peak_bytes=200)  # 2x: alert + ratchet
+    mon.note_step(step=4, hbm_peak_bytes=250)  # < 1.5x of the NEW base
+    mon.note_step(step=5, hbm_peak_bytes=350)  # past the ratchet again
+    assert [a["kind"] for a in mon.alerts] == [
+        "train.hbm_growth", "train.hbm_growth",
+    ]
+
+
+def test_health_request_side_rates_and_slow_with_cooldown():
+    clock = Clock()
+    mon = health.HealthMonitor(_cfg(), emit=False, clock=clock)
+    mon.note_request(0.02)  # > slow_request_s=0.01
+    assert [a["kind"] for a in mon.alerts] == ["serve.slow_request"]
+    mon.note_request(0.02)  # cooldown_s suppresses the repeat
+    assert len(mon.alerts) == 1
+    clock.t = 31.0
+    mon.note_request(0.02)
+    assert len(mon.alerts) == 2
+    # shed rate: 2 sheds in 12 requests > 5%
+    for _ in range(8):
+        mon.note_request(0.0)
+    mon.note_request(0.0, shed=True)
+    mon.note_request(0.0, shed=True)
+    assert [a["kind"] for a in mon.alerts][-1] == "serve.shed_rate"
+    # deadline-miss rate over dispatches
+    mon2 = health.HealthMonitor(_cfg(), emit=False, clock=clock)
+    mon2.note_dispatch(requests=10, misses=6)
+    assert [a["kind"] for a in mon2.alerts] == ["serve.deadline_miss"]
+
+
+def test_health_rates_slide_not_lifetime():
+    """The miss rate is a sliding window: hours of healthy traffic must
+    not bury an SLO collapse, and a cold-start burst must age out."""
+    clock = Clock()
+    mon = health.HealthMonitor(
+        _cfg(rate_window=32, cooldown_s=0.0), emit=False, clock=clock
+    )
+    # long healthy history — lifetime ratio would need thousands of
+    # misses to cross 0.5; the window needs at most one window's worth
+    for _ in range(20):
+        mon.note_dispatch(requests=10, misses=0)
+    assert not mon.alerts
+    mon.note_dispatch(requests=20, misses=20)  # collapse: 20/32 window
+    assert [a["kind"] for a in mon.alerts] == ["serve.deadline_miss"]
+    # ...and healthy traffic ages the burst out: once the misses have
+    # slid out of the window, the alert stops re-firing
+    for _ in range(2):
+        mon.note_dispatch(requests=10, misses=0)  # burst still in-window
+    mon.alerts.clear()
+    for _ in range(10):
+        mon.note_dispatch(requests=10, misses=0)
+    assert not mon.alerts
+
+
+def test_failed_request_still_reaches_the_monitor():
+    """A request that raises (dispatch error, timeout) must still be
+    noted — the slowest requests are exactly the failing ones."""
+    from keystone_tpu.serve.server import ServeApp
+
+    class Exploding(FakeExported):
+        def __call__(self, batch):
+            raise RuntimeError("device on fire")
+
+    health.reset_monitor()
+    app = ServeApp(exported=Exploding(), deadline_ms=1.0)
+    before = health.get_monitor()._req_total
+    with pytest.raises(RuntimeError):
+        app.predict(_rows(1))
+    assert health.get_monitor()._req_total == before + 1
+    app.shutdown()
+
+
+def test_events_run_resets_health_baselines(tmp_path):
+    health.reset_monitor()
+    health.get_monitor().note_step(step=2, wall_s=123.0)  # stale baseline
+    stale = health.get_monitor()
+    with events.run(str(tmp_path)):
+        assert health.get_monitor() is not stale  # fresh per run
+
+
+def test_health_check_run_offline_replay(tmp_path):
+    from keystone_tpu.observe import telemetry
+
+    health.reset_monitor()
+    with events.run(str(tmp_path)) as log:
+        sl = telemetry.active_step_log()
+        sl.record("train", step=1, loss=1.0)
+        sl.record("train", step=2, loss=float("nan"))
+        run_dir = log.run_dir
+    alerts = health.check_run(run_dir)
+    assert [a["kind"] for a in alerts] == ["train.nan_loss"]
+
+
+# ---------------------------------------------------------------------------
+# deterministic fault drills → alert events → observe top
+
+
+def test_train_nan_fault_fires_alert_visible_in_top_once(tmp_path, capsys):
+    import jax
+
+    from keystone_tpu.models import lm_transformer as lm
+    from keystone_tpu.observe import top
+
+    health.reset_monitor()
+    faults.configure("train.nan:@2:0")
+    try:
+        corpus = lm.synthetic_corpus(512, 64, seed=0)
+        model = lm.TransformerLM.create(
+            jax.random.key(0), vocab=64, max_seq=16, dim=32, depth=1,
+            num_heads=2,
+        )
+        with events.run(str(tmp_path)) as log:
+            lm.train(model, corpus, steps=4, batch=4, seq=16, lr=1e-3)
+            run_dir = log.run_dir
+    finally:
+        faults.reset()
+    alerts = [
+        e for e in events.read_events(run_dir) if e.get("event") == "alert"
+    ]
+    assert [a["action"] for a in alerts] == ["train.nan_loss"]
+    assert alerts[0]["step"] == 3  # the step AFTER the @2-keyed poison
+    # step spans recorded alongside
+    recs = spans_mod.read_spans(run_dir)
+    assert {"train.step", "train.host_batch", "train.compute"} <= {
+        r["name"] for r in recs
+    }
+    top.main([run_dir, "--once"])
+    out = capsys.readouterr().out
+    assert "ALERTS" in out and "train.nan_loss=1" in out
+    # ...and the report renders alert + goodput sections from the same dir
+    from keystone_tpu.observe import report
+
+    txt = report.render(run_dir)
+    assert "alerts: train.nan_loss=1" in txt
+    assert "goodput (where the time went" in txt
+
+
+def test_serve_slow_request_fault_fires_alert(tmp_path, monkeypatch):
+    from keystone_tpu.serve.server import ServeApp
+
+    health.reset_monitor()
+    monkeypatch.setenv("KEYSTONE_SERVE_SLOW_MS", "5")
+    faults.configure("serve.slow_request:@0:0")
+    try:
+        with events.run(str(tmp_path)) as log:
+            app = ServeApp(exported=FakeExported(), deadline_ms=5.0)
+            app.predict(_rows(1))
+            app.shutdown()
+            run_dir = log.run_dir
+    finally:
+        faults.reset()
+    alerts = [
+        e for e in events.read_events(run_dir) if e.get("event") == "alert"
+    ]
+    assert any(a["action"] == "serve.slow_request" for a in alerts)
+    snap = metrics.get_registry().snapshot()
+    assert snap.get("alerts{kind=serve.slow_request}", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# stream rotation under KEYSTONE_OBSERVE_MAX_MB
+
+
+def test_steps_and_spans_rotate_under_size_cap(tmp_path, monkeypatch):
+    from keystone_tpu.observe import telemetry
+
+    monkeypatch.setenv("KEYSTONE_OBSERVE_MAX_MB", "0.002")  # ~2 KiB
+    health.reset_monitor()
+    with events.run(str(tmp_path)) as log:
+        sl = telemetry.active_step_log()
+        spl = spans_mod.active_span_log()
+        for i in range(200):
+            sl.record("train", step=i, filler="x" * 64)
+            spl.record_span("unit", wall_s=0.001, bucket="compute", idx=i)
+        run_dir = log.run_dir
+    for name in ("steps.jsonl", "spans.jsonl"):
+        path = os.path.join(run_dir, name)
+        assert os.path.isfile(path) and os.path.isfile(path + ".1")
+        # current generation stays under the cap (+1 record of slack)
+        assert os.path.getsize(path) <= 2.5 * 1024
+        cur = events.read_jsonl(path)
+        old = events.read_jsonl(path + ".1")
+        assert cur and old  # both generations parse
+    # the newest record survived rotation
+    last = events.read_jsonl(os.path.join(run_dir, "steps.jsonl"))[-1]
+    assert last["step"] == 199
+    # read_spans stitches rotated + current in order
+    idxs = [r["idx"] for r in spans_mod.read_spans(run_dir)]
+    assert idxs[-1] == 199 and idxs == sorted(idxs)
+
+
+def test_rotation_env_parse():
+    assert events.max_bytes_from_env() is None
+    os.environ["KEYSTONE_OBSERVE_MAX_MB"] = "1.5"
+    try:
+        assert events.max_bytes_from_env() == int(1.5 * 2**20)
+        os.environ["KEYSTONE_OBSERVE_MAX_MB"] = "garbage"
+        assert events.max_bytes_from_env() is None
+        os.environ["KEYSTONE_OBSERVE_MAX_MB"] = "-1"
+        assert events.max_bytes_from_env() is None
+    finally:
+        del os.environ["KEYSTONE_OBSERVE_MAX_MB"]
+
+
+# ---------------------------------------------------------------------------
+# event-schema registry: the drift check
+
+
+def test_event_schema_registry_covers_every_emit_site():
+    """Grep every ``.emit("<kind>"`` call and ``event_kind="<kind>"``
+    argument in the source tree; any kind not declared in
+    observe/schema.py fails — the one-home rule, enforced."""
+    from keystone_tpu.observe import schema
+
+    root = pathlib.Path(__file__).resolve().parents[1]
+    pat_emit = re.compile(r'\.emit\(\s*"([a-z_]+)"')
+    pat_kind = re.compile(r'event_kind\s*[:=]\s*(?:str\s*=\s*)?"([a-z_]+)"')
+    found: dict[str, list[str]] = {}
+    files = list((root / "keystone_tpu").rglob("*.py"))
+    files.append(root / "bench.py")
+    for path in files:
+        text = path.read_text()
+        for pat in (pat_emit, pat_kind):
+            for kind in pat.findall(text):
+                found.setdefault(kind, []).append(str(path))
+    assert found, "no emit sites found — the grep went stale"
+    undeclared = {
+        k: v for k, v in found.items() if k not in schema.declared()
+    }
+    assert not undeclared, (
+        f"event kinds emitted but not declared in observe/schema.py: "
+        f"{undeclared}"
+    )
+    # the known core kinds really are being picked up by the grep
+    assert {"node", "optimize", "serve", "alert"} <= set(found)
+
+
+def test_schema_note_warns_once_on_unknown_kind(caplog):
+    from keystone_tpu.observe import schema
+
+    assert schema.note("run_start") is True
+    schema._warned.discard("totally_unknown")
+    assert schema.note("totally_unknown") is False
+    assert schema.note("totally_unknown") is False  # warn-once
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition
+
+
+def test_metrics_to_prometheus_exposition():
+    reg = metrics.MetricsRegistry()
+    reg.counter("reqs", route="/predict").inc(2)
+    reg.gauge("depth").set(1.5)
+    t = reg.timer("lat")
+    for v in (0.01, 0.02, 0.03):
+        t.observe(v)
+    reg.counter("weird", label='a"b\\c\nd').inc()
+    text = reg.to_prometheus()
+    assert "# TYPE reqs counter" in text
+    assert 'reqs{route="/predict"} 2' in text
+    assert "# TYPE depth gauge" in text and "depth 1.5" in text
+    assert "# TYPE lat summary" in text
+    assert "lat_count 3" in text
+    assert "lat_sum 0.06" in text
+    assert 'lat{quantile="0.5"} 0.02' in text
+    assert 'weird{label="a\\"b\\\\c\\nd"} 1' in text
+    # every line is exposition-shaped
+    for line in text.strip().splitlines():
+        assert line.startswith("# TYPE") or re.match(
+            r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{.*\})? \S+$", line
+        ), line
+
+
+def test_metrics_endpoint_content_negotiation(free_tcp_port):
+    from http.server import ThreadingHTTPServer
+
+    from keystone_tpu.serve.server import ServeApp, _handler_for
+
+    health.reset_monitor()
+    app = ServeApp(exported=FakeExported(), deadline_ms=5.0)
+    httpd = ThreadingHTTPServer(
+        ("127.0.0.1", free_tcp_port), _handler_for(app)
+    )
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    try:
+        base = f"http://127.0.0.1:{free_tcp_port}"
+        metrics.get_registry().counter("serve_requests").inc(0)
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            body = r.read().decode()
+        assert "# TYPE" in body and "serve_requests" in body
+        req = urllib.request.Request(
+            f"{base}/metrics", headers={"Accept": "application/json"}
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.headers["Content-Type"] == "application/json"
+            payload = json.load(r)
+        assert "metrics" in payload and "serve_requests" in payload["metrics"]
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        app.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# observe trace CLI
+
+
+def test_observe_trace_cli_smoke(tmp_path, capsys):
+    from keystone_tpu.observe import report
+
+    health.reset_monitor()
+    clock = Clock()
+    with events.run(str(tmp_path)) as log:
+        mb = MicroBatcher(
+            FakeExported(), buckets=(8,), deadline_ms=10.0, clock=clock,
+            start=False,
+        )
+        with spans_mod.span("serve.request", rid=0):
+            mb.submit(_rows(2), rid=0)
+        clock.t = 0.010
+        mb.pump(now=0.010)
+        run_dir = log.run_dir
+    report.main(["trace", run_dir])
+    out = capsys.readouterr().out
+    assert "trace " in out and "critical path" in out
+    assert "serve.request" in out and "serve.queue_wait" in out
+    assert "goodput (where the time went" in out
+    # --request filters to the request's trace AND follows its batch link
+    report.main(["trace", run_dir, "--request", "0"])
+    out = capsys.readouterr().out
+    assert "serve.request" in out and "serve.batch" in out
+    report.main(["trace", run_dir, "--request", "nope"])
+    out = capsys.readouterr().out
+    assert "no trace with a root span rid" in out
+
+
+def test_sparkline_survives_all_nan_window():
+    from keystone_tpu.observe.top import SPARK, sparkline
+
+    nan = float("nan")
+    # mixed: non-finite renders as the full bar
+    s = sparkline([1.0, 2.0, nan, 3.0])
+    assert len(s) == 4 and s[2] == SPARK[-1]
+    # an ENTIRELY non-finite window still renders (divergence that
+    # stuck) instead of vanishing mid-incident
+    s = sparkline([nan] * 10)
+    assert s == SPARK[-1] * 10
+
+
+def test_observe_trace_cli_usage():
+    from keystone_tpu.observe import spans as spans_cli
+
+    with pytest.raises(SystemExit):
+        spans_cli.main([])
+    with pytest.raises(SystemExit):
+        spans_cli.main(["--help"])
