@@ -648,6 +648,90 @@ def _lm_tuned_config() -> dict | None:
     return t
 
 
+def bench_solver_mfu(n: int | None = None, d_feats: int | None = None) -> dict:
+    """Streamed-vs-materialized fused fit: the solver-MFU trajectory
+    record (plan/fused_fit.py). One featurize→fit workload (cosine
+    random features → exact normal-equations ridge) run both ways on
+    the same data: the classic path materializes the (N, D) feature
+    matrix then fits; the planned path streams staged chunks through
+    ONE fused featurize+accumulate jit. Records the throughput delta,
+    the planner's chosen Gram operator + decisions, and the
+    cost-priced solver TFLOP/s — runs on the CPU fallback too (the
+    delta there sanity-checks the shape of the win; the MFU number is
+    the on-chip target)."""
+    import jax
+
+    from keystone_tpu import plan as plan_mod
+    from keystone_tpu.core.pipeline import ChainedLabelEstimator
+    from keystone_tpu.ops.linear import BlockLeastSquaresEstimator
+    from keystone_tpu.ops.stats import CosineRandomFeatures
+    from keystone_tpu.plan import executor as _plan_exec
+    from keystone_tpu.ops.util import ClassLabelIndicators
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    n = n or (65_536 if on_cpu else 524_288)
+    d_in, k, passes = 256, 10, 5
+    d = d_feats or (512 if on_cpu else 4096)
+    chunk = 4096
+    rng = np.random.default_rng(7)
+    # HOST corpus: the fit's real starting point — the classic path
+    # places it whole, the streamed path overlaps h2d with accumulate
+    x = rng.normal(size=(n, d_in)).astype(np.float32)
+    labels = rng.integers(0, k, size=n).astype(np.int32)
+    y = ClassLabelIndicators(num_classes=k)(labels)
+    feat = CosineRandomFeatures.create(d_in, d, jax.random.key(0))
+    # the TIMIT epoch regime (one 4096-wide solver block, multi-pass
+    # BCD): Gram work is identical both ways, but the classic data-form
+    # passes re-touch all N rows per epoch while the streamed Gram-form
+    # passes are N-independent — the single-block slice keeps the
+    # comparison FLOP-honest (a B-block full Gram costs B× the
+    # per-block Grams; the planner's budget guard owns that trade)
+    est = BlockLeastSquaresEstimator(block_size=d, num_iter=passes, lam=1.0)
+    chain = ChainedLabelEstimator(prefix=feat, est=est)
+
+    featurize = jax.jit(lambda b: feat(b))
+
+    def materialized():
+        # the unplanned model codepath: featurize the whole corpus to a
+        # resident feature matrix, then fit from it
+        feats = jax.block_until_ready(featurize(jax.device_put(x)))
+        return est.fit(feats, y).xs[0]
+
+    # plan ONCE (a real corpus fit plans once; the probe/profiling cost
+    # is not the steady state), then time the planned execution
+    plan = plan_mod.plan_fit(chain, x, y, chunk_size=chunk, prefetch=4)
+
+    def streamed():
+        state = _plan_exec.fit_stream(plan, x, y)
+        return est.fit_stats_finalize(state, widths=plan.fit.widths).xs[0]
+
+    mat_s = _timed(materialized, iters=3)
+    stream_s = _timed(streamed, iters=3)
+    # modeled fit FLOPs: featurize gemm + Gram/AᵀB accumulation
+    flops = 2.0 * n * d_in * d + 2.0 * n * d * (d + k)
+    rec = {
+        "n_rows": n,
+        "d_features": d,
+        "bcd_passes": passes,
+        "chunk_size": plan.chunk_size,
+        "materialized_fit_s": round(mat_s, 4),
+        "streamed_fit_s": round(stream_s, 4),
+        "streamed_vs_materialized": round(mat_s / stream_s, 3),
+        "rows_per_s": round(n / stream_s, 1),
+        "chosen_operator": plan.fit.gram if plan.fit else "?",
+        "solver_tflops_per_chip": round(
+            flops / stream_s / 1e12 / len(jax.devices()), 3
+        ),
+        "decisions": plan.decisions,
+    }
+    peak = _device_peak()
+    if peak is not None:
+        rec["mfu_streamed_vs_bf16_peak"] = round(
+            flops / stream_s / len(jax.devices()) / peak, 4
+        )
+    return rec
+
+
 def bench_lm_train() -> dict:
     """One sharded LM train step (models/lm_transformer.py): the
     training-side MFU workload — forward+backward+AdamW as a single
@@ -1365,6 +1449,14 @@ def main() -> None:
         result["goodput"] = bench_goodput()
     except Exception as e:  # noqa: BLE001 — same contract as above
         result["goodput"] = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+    # fused streaming-fit record (plan/fused_fit.py): streamed-vs-
+    # materialized fit delta + chosen Gram operator + rows/s — the
+    # solver-MFU trajectory the next chip session reads, runs on the
+    # CPU fallback too
+    try:
+        result["solver_mfu"] = bench_solver_mfu()
+    except Exception as e:  # noqa: BLE001 — same contract as above
+        result["solver_mfu"] = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
     # per-node operator breakdown (observe subsystem): wall time per
     # pipeline node plus compiler-modeled FLOPs/bytes when available
     result["mnist_per_node"] = mnist.get("per_node", {})

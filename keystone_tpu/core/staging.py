@@ -25,6 +25,10 @@ Two layers:
   then free each dead staged input once the result that consumed it has
   been forced — peak device residency stays a small constant:
   ``depth`` staged inputs + ``inflight`` un-forced outputs.
+  :func:`fold_staged` is the accumulate form of the same drain: a
+  carried state folded over the staged stream (the streaming
+  normal-equations fit), inputs freed once the state chain has been
+  forced past them.
 
 Transfers are observable: ``plan_transfer_*`` / ``plan_shard_*`` metrics
 counters, and one ``optimize`` event (``source="staging"``) per staged
@@ -111,6 +115,27 @@ def free_buffers(tree: Any, keep: Any = ()) -> None:
             pass
 
 
+def _placement_owned(staged: Any, chunk: Any) -> bool:
+    """Did this placement create buffers the engine may free?
+
+    Per-LEAF identity, not container identity: ``device_put`` on a
+    pytree rebuilds the tuple even when every array was already
+    resident in the right place — treating that as owned would free
+    buffers the CALLER still holds (a full-range slice is the same
+    array object as its source). Ownership is claimed only when every
+    leaf moved; a mixed placement (one leaf staged, one borrowed) is
+    conservatively borrowed — the moved leaves just fall to GC instead
+    of the eager free.
+    """
+    if staged is chunk:
+        return False
+    s_leaves = jax.tree_util.tree_leaves(staged)
+    c_leaves = jax.tree_util.tree_leaves(chunk)
+    if not s_leaves or len(s_leaves) != len(c_leaves):
+        return True
+    return all(s is not c for s, c in zip(s_leaves, c_leaves))
+
+
 def stage_chunks(
     chunks: Iterable[tuple[Any, int]],
     *,
@@ -155,7 +180,7 @@ def stage_chunks(
             if spec is not None
             else jax.device_put(chunk)
         )
-        owned = staged is not chunk
+        owned = _placement_owned(staged, chunk)
         if owned and span_log is not None:
             # only real transfers become spans (same rule as the
             # counters below); with depth > 0 they run on the staging
@@ -316,6 +341,54 @@ def run_staged(
                 yield force(pending.popleft())
         while pending:
             yield force(pending.popleft())
+    finally:
+        close = getattr(staged_iter, "close", None)
+        if close is not None:
+            close()
+
+
+def fold_staged(
+    chunks: Iterable[tuple[Any, int]],
+    fn: Callable,
+    init: Any,
+    *,
+    sharding: Any = None,
+    stage_depth: int | None = None,
+    inflight: int = 2,
+    free_inputs: bool = True,
+) -> Any:
+    """Fold a staged chunk stream through a carried state:
+    ``state = fn(state, staged_chunk, valid_rows)`` per chunk, returning
+    the final (forced) state — the accumulate form of :func:`run_staged`
+    for consumers whose output is a running reduction (the streaming
+    normal-equations fit) rather than per-chunk rows.
+
+    Staging overlap is identical to :func:`run_staged` — the worker
+    thread places chunk k+1 while chunk k computes. The state chain
+    serializes the compute anyway, so backpressure works on the INPUTS:
+    up to ``inflight`` dispatched-but-unforced updates may hold their
+    staged chunks; past that the newest state is forced (which, the
+    chain being linear, completes every earlier update too) and the
+    dead staged inputs are freed in one sweep.
+    """
+    staged_iter = stage_chunks(chunks, sharding=sharding, depth=stage_depth)
+    state = init
+    pending: deque = deque()  # staged inputs of dispatched updates
+
+    def drain(state):
+        state = jax.block_until_ready(state)
+        while pending:
+            free_buffers(pending.popleft(), keep=state)
+        return state
+
+    try:
+        for staged, valid, owned in staged_iter:
+            state = fn(state, staged, valid)
+            if free_inputs and owned:
+                pending.append(staged)
+            if len(pending) > max(inflight, 0):
+                state = drain(state)
+        return drain(state)
     finally:
         close = getattr(staged_iter, "close", None)
         if close is not None:

@@ -280,18 +280,39 @@ def run_streaming(
         mixture_weight=conf.mixture_weight,
         class_chunk=min(16, num_classes),
     )
-    from keystone_tpu.core.checkpoint import checkpointed_fit
+    if plan_mod.enabled() and not conf.checkpoint_dir:
+        # KEYSTONE_PLAN: the weighted fit streams chunks through the
+        # per-class normal-equation accumulators (plan/fused_fit.py).
+        # fit_streaming's planner prices the (C, D, D) state against
+        # the memory budget and falls back to the materialized fit —
+        # with a recorded decision — when per-class Grams at real
+        # ImageNet class counts don't fit.
+        from keystone_tpu.core.pipeline import (
+            ChainedLabelEstimator,
+            Identity,
+        )
 
-    model = jax.block_until_ready(
-        checkpointed_fit(
-            est,
+        fitted = plan_mod.fit_streaming(
+            ChainedLabelEstimator(prefix=Identity(), est=est),
             f_train,
             indicators,
-            checkpoint_dir=conf.checkpoint_dir,
-            every=conf.checkpoint_every,
             n_valid=n_train,
+            mesh=mesh,
         )
-    )
+        model = jax.block_until_ready(fitted[-1])
+    else:
+        from keystone_tpu.core.checkpoint import checkpointed_fit
+
+        model = jax.block_until_ready(
+            checkpointed_fit(
+                est,
+                f_train,
+                indicators,
+                checkpoint_dir=conf.checkpoint_dir,
+                every=conf.checkpoint_every,
+                n_valid=n_train,
+            )
+        )
     t_fit = time.perf_counter()
 
     top5 = TopKClassifier(k=min(5, num_classes))

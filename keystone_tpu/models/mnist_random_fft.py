@@ -249,7 +249,25 @@ def run(conf: MnistRandomFFTConfig, mesh=None) -> dict:
     )
     t_load = time.perf_counter()
 
-    train_blocks = jax.block_until_ready(featurize(batch_featurizers, train_x))
+    from keystone_tpu import plan as plan_mod
+
+    # KEYSTONE_PLAN: the TRAIN fit streams — featurize + normal-equation
+    # accumulation fused into one jitted chunk step by the planner
+    # (plan/fused_fit.py), so the feature blocks are never materialized
+    # for the fit; the λ-sweep and eval paths still need them resident.
+    streamed_fit = plan_mod.enabled() and not conf.lam_sweep
+    # ONE bank object for the fit, the train eval, and the test pass —
+    # planner prefix sharing keys on node identity
+    bank = (
+        FeaturizerBank(batches=tuple(tuple(g) for g in batch_featurizers))
+        if plan_mod.enabled()
+        else None
+    )
+    train_blocks = None
+    if not streamed_fit:
+        train_blocks = jax.block_until_ready(
+            featurize(batch_featurizers, train_x)
+        )
     t_feat = time.perf_counter()
 
     lam = conf.lam
@@ -279,9 +297,21 @@ def run(conf: MnistRandomFFTConfig, mesh=None) -> dict:
     est = BlockLeastSquaresEstimator(
         block_size=conf.block_size, num_iter=1, lam=lam
     )
-    model = jax.block_until_ready(
-        est.fit(train_blocks, label_indicators, n_valid=n_train)
-    )
+    if streamed_fit:
+        from keystone_tpu.core.pipeline import ChainedLabelEstimator
+
+        fitted_fit = plan_mod.fit_streaming(
+            ChainedLabelEstimator(prefix=bank, est=est),
+            train_x,
+            label_indicators,
+            n_valid=n_train,
+            mesh=mesh,
+        )
+        model = jax.block_until_ready(fitted_fit[-1])
+    else:
+        model = jax.block_until_ready(
+            est.fit(train_blocks, label_indicators, n_valid=n_train)
+        )
     t_fit = time.perf_counter()
 
     evaluator = MulticlassClassifierEvaluator(NUM_CLASSES)
@@ -297,12 +327,22 @@ def run(conf: MnistRandomFFTConfig, mesh=None) -> dict:
 
         return cb
 
-    model.apply_and_evaluate(
-        train_blocks, streaming_eval("train", train_y, n_train)
-    )
+    if streamed_fit:
+        # blocks were never materialized: the train error comes from the
+        # same planned apply pass the test pass uses
+        pred = plan_mod.execute(
+            Pipeline.of(bank, model, MaxClassifier()), train_x, mesh=mesh
+        )
+        errors["train"] = evaluator(pred, train_y, n_valid=n_train).error
+        logger.info(
+            "train error (planned): %.2f%%", 100 * errors["train"]
+        )
+    else:
+        model.apply_and_evaluate(
+            train_blocks, streaming_eval("train", train_y, n_train)
+        )
     test_y = np.zeros(test_x.shape[0], np.int32)
     test_y[:n_test] = test.labels
-    from keystone_tpu import plan as plan_mod
 
     if plan_mod.enabled():
         # KEYSTONE_PLAN: the test pass runs through the cost-based
@@ -312,7 +352,6 @@ def run(conf: MnistRandomFFTConfig, mesh=None) -> dict:
         # mesh — dispatched data-sharded so the pass runs as one SPMD
         # program per segment. Predictions are identical to the block
         # path; only the execution differs.
-        bank = FeaturizerBank(batches=tuple(tuple(g) for g in batch_featurizers))
         pred = plan_mod.execute(
             Pipeline.of(bank, model, MaxClassifier()), test_x, mesh=mesh
         )

@@ -15,6 +15,8 @@ import numpy as np
 
 from keystone_tpu.core.config import arg, parse_config
 from keystone_tpu.core.logging import get_logger
+from keystone_tpu.core.pipeline import Pipeline, Transformer
+from keystone_tpu.core.treenode import treenode
 from keystone_tpu.evaluation import MulticlassClassifierEvaluator
 from keystone_tpu.loaders.labeled import LabeledData
 from keystone_tpu.loaders.timit import NUM_CLASSES, TIMIT_DIMENSION, load_timit_split
@@ -62,6 +64,22 @@ class TimitConfig:
     synthetic: int = arg(default=0, help="if > 0, N synthetic frames")
 
 
+@treenode
+class ScaledCosineBank(Transformer):
+    """The full TIMIT featurizer as one row-wise Transformer: every
+    (cosine features → standard scaler) chain applied to the batch,
+    returning the list of (N, cosine_features) blocks — the shape the
+    block solver consumes. Being a treenode lets the planner's
+    fused-fit rule absorb the whole bank into the streaming
+    normal-equations sink (one jitted chunk step, blocks never
+    corpus-resident)."""
+
+    chains: tuple  # of Pipeline(featurizer >> fitted scaler)
+
+    def __call__(self, batch):
+        return [chain(batch) for chain in self.chains]
+
+
 def _load(conf: TimitConfig, which: str) -> LabeledData:
     if conf.synthetic:
         n = conf.synthetic if which == "train" else max(conf.synthetic // 5, 1)
@@ -104,6 +122,19 @@ def run(conf: TimitConfig, mesh=None) -> dict:
     x_train = shard_batch(train.data, mesh)
     x_test = shard_batch(test.data, mesh)
 
+    from keystone_tpu import plan as plan_mod
+
+    # KEYSTONE_PLAN: the fit streams chunks through featurize+scale+
+    # accumulate fused (plan/fused_fit.py) — the corpus-wide block list
+    # (num_cosines × 4096 × N, the big resident object of the classic
+    # path) is never materialized. Scalers still need their one pass
+    # over each block's raw features, but each block is dropped as soon
+    # as its scaler is fitted. The λ-sweep and the between-epoch
+    # checkpoint protocol both consume resident blocks — those runs
+    # keep the classic path.
+    streamed_fit = plan_mod.enabled() and not (
+        conf.lam_sweep or conf.checkpoint_dir
+    )
     apply_node = jax.jit(lambda node, b: node(b))
     # per-batch cosine features, standard-scaled (fit on train)
     train_blocks, scalers = [], []
@@ -111,7 +142,9 @@ def run(conf: TimitConfig, mesh=None) -> dict:
         raw = apply_node(f, x_train)
         scaler = StandardScaler().fit(raw, n_valid=n_train)
         scalers.append(scaler)
-        train_blocks.append(apply_node(scaler, raw))
+        if not streamed_fit:
+            train_blocks.append(apply_node(scaler, raw))
+        del raw
 
     y = np.zeros(x_train.shape[0], np.int32)
     y[:n_train] = train.labels
@@ -148,32 +181,50 @@ def run(conf: TimitConfig, mesh=None) -> dict:
     est = BlockLeastSquaresEstimator(
         block_size=conf.cosine_features, num_iter=conf.num_epochs, lam=lam
     )
-    from keystone_tpu.core.checkpoint import checkpointed_fit
-
-    model = jax.block_until_ready(
-        checkpointed_fit(
-            est,
-            train_blocks,
-            indicators,
-            checkpoint_dir=conf.checkpoint_dir,
-            every=conf.checkpoint_every,
-            n_valid=n_train,
+    bank = ScaledCosineBank(
+        chains=tuple(
+            Pipeline.of(f, s) for f, s in zip(featurizers, scalers)
         )
     )
+    if streamed_fit:
+        from keystone_tpu.core.pipeline import ChainedLabelEstimator
+
+        fitted = plan_mod.fit_streaming(
+            ChainedLabelEstimator(prefix=bank, est=est),
+            x_train,
+            indicators,
+            n_valid=n_train,
+            mesh=mesh,
+        )
+        model = jax.block_until_ready(fitted[-1])
+    else:
+        from keystone_tpu.core.checkpoint import checkpointed_fit
+
+        model = jax.block_until_ready(
+            checkpointed_fit(
+                est,
+                train_blocks,
+                indicators,
+                checkpoint_dir=conf.checkpoint_dir,
+                every=conf.checkpoint_every,
+                n_valid=n_train,
+            )
+        )
     t_fit = time.perf_counter()
 
     classify = MaxClassifier()
     evaluator = MulticlassClassifierEvaluator(NUM_CLASSES)
-    train_eval = evaluator(classify(model(train_blocks)), y, n_valid=n_train)
+    score = jax.jit(lambda b: model(bank(b)))
+    # classic path: the blocks are already resident — don't re-featurize
+    train_scores = (
+        score(x_train) if streamed_fit else model(train_blocks)
+    )
+    train_eval = evaluator(classify(train_scores), y, n_valid=n_train)
 
-    test_blocks = [
-        apply_node(s, apply_node(f, x_test))
-        for f, s in zip(featurizers, scalers)
-    ]
     y_test = np.zeros(x_test.shape[0], np.int32)
     y_test[:n_test] = test.labels
     test_eval = evaluator(
-        classify(model(test_blocks)), y_test, n_valid=n_test
+        classify(score(x_test)), y_test, n_valid=n_test
     )
 
     result = {

@@ -326,15 +326,17 @@ def _telemetry_sections(run_dir: str, summary: dict) -> list[str]:
         # rotation-aware: a size-capped run's earliest records live in
         # the .1 generation
         recs = _events.read_jsonl_rotated(steps_path)
-        # plan chunk-stream rows (source="plan") carry whole-stream
-        # walls on a process-lifetime sequence — summarized separately
-        # so they can't inflate the per-step percentiles
+        # plan chunk-stream rows (source="plan") and fused-fit solver
+        # rows (source="solver") carry whole-stream walls on a
+        # process-lifetime sequence — summarized separately so they
+        # can't inflate the per-step percentiles
         steps = [
             r
             for r in recs
             if "step" in r and r.get("source", "train") == "train"
         ]
         plan_rows = [r for r in recs if r.get("source") == "plan"]
+        solver_rows = [r for r in recs if r.get("source") == "solver"]
         if steps:
             last = steps[-1]
             walls = [
@@ -387,6 +389,28 @@ def _telemetry_sections(run_dir: str, summary: dict) -> list[str]:
                 f"{int(rows)} row(s)"
                 + (f", last {rps[-1]:,.0f} rows/s" if rps else "")
             )
+            lines.append("")
+        if solver_rows:
+            # fused streaming fits get their own heading: one row per
+            # fit (rows/s, chunks, cost-priced MFU, chosen Gram
+            # operator), not mixed into the generic plan chunk lines
+            lines.append(
+                f"solver streams (fused streaming fits): "
+                f"{len(solver_rows)} fit(s)"
+            )
+            for r in solver_rows[-8:]:
+                parts = [f"  {r.get('estimator', '?')}"]
+                if isinstance(r.get("rows"), (int, float)):
+                    parts.append(f"{int(r['rows'])} rows")
+                if isinstance(r.get("chunks"), (int, float)):
+                    parts.append(f"{int(r['chunks'])} chunk(s)")
+                if isinstance(r.get("rows_per_s"), (int, float)):
+                    parts.append(f"{r['rows_per_s']:,.0f} rows/s")
+                if isinstance(r.get("mfu"), (int, float)):
+                    parts.append(f"mfu {r['mfu']:.4f}")
+                if r.get("gram"):
+                    parts.append(f"gram={r['gram']}")
+                lines.append("  ".join(parts))
             lines.append("")
         serve_rows = [r for r in recs if r.get("source") == "serve"]
         if serve_rows:

@@ -16,7 +16,8 @@ Step record schema (one JSON object per line; extra fields free-form):
 ==================  ====================================================
 ``ts``              unix time (float, seconds)
 ``run``             run id (same id as the run's events)
-``source``          ``train`` (LM loop) | ``plan`` (chunked executor)
+``source``          ``train`` (LM loop) | ``plan`` (chunked executor) |
+                    ``solver`` (fused streaming fits) | ``serve``
 ``step``            step index (1-based, the completed step)
 ``loss``            host-read scalar loss
 ``wall_s``          wall-clock of the bracket the rates derive from

@@ -22,6 +22,7 @@ Padding: batches zero-padded for sharding (``parallel.mesh.pad_batch``) pass
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Callable
 
@@ -145,12 +146,23 @@ def ridge_solve(
     )
 
 
+ENV_MATMUL_PRECISION = "KEYSTONE_MATMUL_PRECISION"
+
+
 def _matmul_precision(precision: str | None):
-    """Context for an estimator-level matmul-precision override; no-op
-    when unset (the jit cache keys on the config state, so fits at
-    different precisions don't collide)."""
+    """Context for an estimator-level matmul-precision override.
+
+    ``precision=None`` falls back to the ``KEYSTONE_MATMUL_PRECISION``
+    env knob (e.g. ``highest`` forces full-f32 MXU accumulation for
+    solver Grams on TPU, where the backend default runs bf16 passes on
+    f32 inputs), and is a no-op when that too is unset. The jit cache
+    keys on the config state, so fits at different precisions don't
+    collide.
+    """
     import contextlib
 
+    if precision is None:
+        precision = os.environ.get(ENV_MATMUL_PRECISION, "").strip() or None
     if precision is None:
         return contextlib.nullcontext()
     return jax.default_matmul_precision(precision)
@@ -212,9 +224,10 @@ class LinearMapEstimator(LabelEstimator):
     lam: float = static_field(default=0.0)
 
     def fit(self, data, labels, n_valid: int | None = None) -> LinearMapper:
-        x, b_mean, a_mean = _linear_map_fit(
-            data, labels, n_valid, self.lam
-        )
+        with _matmul_precision(None):
+            x, b_mean, a_mean = _linear_map_fit(
+                data, labels, n_valid, self.lam
+            )
         scaler = StandardScalerModel(mean=a_mean, std=None)
         return LinearMapper(x=x, b=b_mean, feature_scaler=scaler)
 
@@ -226,14 +239,64 @@ class LinearMapEstimator(LabelEstimator):
         ``Array(lambda)`` capability — see
         ``BlockLeastSquaresEstimator.fit_sweep``)."""
         lams_arr = jnp.asarray(lams)
-        xs, b_mean, a_mean = _linear_map_fit_sweep(
-            data, labels, n_valid, lams_arr
-        )
+        with _matmul_precision(None):
+            xs, b_mean, a_mean = _linear_map_fit_sweep(
+                data, labels, n_valid, lams_arr
+            )
         scaler = StandardScalerModel(mean=a_mean, std=None)
         return [
             LinearMapper(x=xs[i], b=b_mean, feature_scaler=scaler)
             for i in range(lams_arr.shape[0])
         ]
+
+    # -- streaming normal-equations protocol (fit_stats_*) ------------
+    # The chunk-accumulating form of the fit: running (AᵀA, AᵀB, Σa,
+    # Σb, n) state updated per chunk, solved at finalize — the planner's
+    # fused featurize→accumulate fit path drives this instead of
+    # requiring the whole feature matrix resident.
+
+    def fit_stats_init(self, d: int, k: int) -> "NormalEqState":
+        return normal_eq_init(d, k)
+
+    def fit_stats_update(
+        self, state, data, labels, n_valid=None, gram_fn=None
+    ) -> "NormalEqState":
+        return normal_eq_update(state, data, labels, n_valid, gram_fn)
+
+    def fit_stats_finalize(self, state, widths=None) -> LinearMapper:
+        ata, atb, b_mean, a_mean, _ = normal_eq_finalize(state)
+        with _matmul_precision(None):
+            x = _ridge_from_stats(ata, atb, self.lam)
+        scaler = StandardScalerModel(mean=a_mean, std=None)
+        return LinearMapper(x=x, b=b_mean, feature_scaler=scaler)
+
+    def fit_sweep_finalize(
+        self, state, lams, widths=None
+    ) -> list[LinearMapper]:
+        """The λ-sweep off ONE accumulated state: the streamed Gram is
+        the expensive part; the per-λ solves are vmapped exactly like
+        :meth:`fit_sweep`."""
+        ata, atb, b_mean, a_mean, _ = normal_eq_finalize(state)
+        lams_arr = jnp.asarray(lams, jnp.float32)
+        with _matmul_precision(None):
+            xs = _ridge_sweep_from_stats(ata, atb, lams_arr)
+        scaler = StandardScalerModel(mean=a_mean, std=None)
+        return [
+            LinearMapper(x=xs[i], b=b_mean, feature_scaler=scaler)
+            for i in range(lams_arr.shape[0])
+        ]
+
+    @staticmethod
+    def fit_stats_flops_per_row(d: int, k: int) -> float:
+        """Modeled accumulation FLOPs per streamed row (Gram + AᵀB) —
+        the planner's cost-model basis for the fused-fit sink."""
+        return 2.0 * d * (d + k)
+
+    @staticmethod
+    def fit_stats_state_bytes(d: int, k: int) -> int:
+        """Resident f32 state bytes — the planner refuses to stream a
+        fit whose state alone would blow the memory budget."""
+        return 4 * (d * d + d * k + 2 * d + 2 * k)
 
 
 def _normal_eq_stats(data, labels, n_valid):
@@ -263,13 +326,145 @@ def _linear_map_fit_sweep(data, labels, n_valid, lams):
     return xs, b_mean, a_mean
 
 
+# ---------------------------------------------------------------------------
+# Streaming normal equations: chunk-accumulated (AᵀA, AᵀB, μa, μb, n)
+# state in f32 — each chunk centered about its own mean, merged with a
+# rank-1 mean-difference correction (Chan's pairwise update), so the
+# centered Gram needs no finalize-time subtraction. This is the
+# fit_stats_init/update/finalize protocol the planner's fused
+# featurize→accumulate path drives (plan/fused_fit.py): the feature
+# matrix is never resident — only the (D, D+K) state is.
+
+
+@treenode
+class NormalEqState:
+    """Running f32 normal-equation statistics over streamed chunks.
+
+    The Gram is kept CENTERED throughout (Chan's pairwise merge): each
+    chunk is centered about its OWN masked mean before contracting, and
+    the merge adds only a small rank-1 mean-difference correction,
+    ``(n·m/(n+m)) · δδᵀ`` with ``δ = μ_chunk − μ_running``. Nothing
+    large is ever subtracted — the finalize is a plain read — which is
+    the difference between ~1e-3 and ~1e-6 relative error on realistic
+    f32 feature scales.
+    """
+
+    ata: jnp.ndarray  # (D, D) centered Σ about the running mean
+    atb: jnp.ndarray  # (D, K) centered cross product
+    mean_a: jnp.ndarray  # (D,) running masked mean of the features
+    mean_b: jnp.ndarray  # (K,) running masked mean of the labels
+    n: jnp.ndarray  # () valid-row count
+
+
+def normal_eq_init(d: int, k: int) -> NormalEqState:
+    """Zero state for a (N, d) → (N, k) streamed fit."""
+    f32 = jnp.float32
+    return NormalEqState(
+        ata=jnp.zeros((d, d), f32),
+        atb=jnp.zeros((d, k), f32),
+        mean_a=jnp.zeros((d,), f32),
+        mean_b=jnp.zeros((k,), f32),
+        n=jnp.zeros((), f32),
+    )
+
+
+def _concat_blocks(data):
+    if isinstance(data, (list, tuple)):
+        return jnp.concatenate([jnp.asarray(b) for b in data], axis=-1)
+    return data
+
+
+@partial(jax.jit, static_argnames=("gram_fn",))
+def _normal_eq_update(state, data, labels, n_valid, gram_fn):
+    data = _concat_blocks(data)
+    f32 = jnp.float32
+    mask = _row_mask(data.shape[0], n_valid, f32)
+    m = jnp.sum(mask)
+    m_safe = jnp.maximum(m, 1.0)
+    a = data.astype(f32)
+    b = labels.astype(f32)
+    mu_a = jnp.sum(a * mask, 0) / m_safe
+    mu_b = jnp.sum(b * mask, 0) / m_safe
+    a_c = (a - mu_a) * mask
+    b_c = (b - mu_b) * mask
+    gram = gram_fn(a_c) if gram_fn is not None else a_c.T @ a_c
+    # Chan merge: an all-pad chunk (m = 0) contributes nothing — the
+    # rank-1 weight n·m/(n+m) and the mean step m/(n+m) both vanish
+    n_new = jnp.maximum(state.n + m, 1.0)
+    w = state.n * m / n_new
+    da = mu_a - state.mean_a
+    db = mu_b - state.mean_b
+    return NormalEqState(
+        ata=state.ata + gram + w * jnp.outer(da, da),
+        atb=state.atb + a_c.T @ b_c + w * jnp.outer(da, db),
+        mean_a=state.mean_a + (m / n_new) * da,
+        mean_b=state.mean_b + (m / n_new) * db,
+        n=state.n + m,
+    )
+
+
+def normal_eq_update(
+    state: NormalEqState,
+    data,
+    labels,
+    n_valid=None,
+    gram_fn=None,
+    precision: str | None = None,
+) -> NormalEqState:
+    """Fold one chunk into the state — ONE jitted step (featurize
+    prefixes fuse in front of it when traced together). ``data`` may be
+    a (rows, d) array or a list of feature blocks (concatenated);
+    ``n_valid`` masks trailing pad rows out of every statistic;
+    ``gram_fn`` swaps the AᵀA operator (e.g. the int8 quantized Gram,
+    :func:`keystone_tpu.ops.gram.ata_int8`) — it must map a centered,
+    masked (rows, d) chunk to a (d, d) f32 Gram; ``precision`` pins
+    the matmul precision (falls back to ``KEYSTONE_MATMUL_PRECISION``),
+    so an estimator's pinned precision reaches the streamed Grams the
+    way it reaches the materialized ones."""
+    with _matmul_precision(precision):
+        return _normal_eq_update(state, data, labels, n_valid, gram_fn)
+
+
+def normal_eq_finalize(state: NormalEqState):
+    """Centered ``(AᵀA, AᵀB, b_mean, a_mean, n)`` — with the Chan-merge
+    state this is a plain read (the Gram was never uncentered)."""
+    n = jnp.maximum(state.n, 1.0)
+    return state.ata, state.atb, state.mean_b, state.mean_a, n
+
+
+@partial(jax.jit, static_argnames=("lam",))
+def _ridge_from_stats(ata, atb, lam: float):
+    return ridge_solve(ata, atb, lam)
+
+
+@jax.jit
+def _ridge_sweep_from_stats(ata, atb, lams):
+    return jax.vmap(lambda l: ridge_solve(ata, atb, l))(lams)
+
+
+def block_widths(d: int, block_size: int) -> tuple[int, ...]:
+    """THE one home of feature-block boundaries: ``_split_blocks``,
+    :class:`BlockLinearMapper`, and the streaming Gram-form BCD all
+    derive block edges here, so block fits and streaming fits can't
+    disagree on where a block (and its masking) starts."""
+    return tuple(
+        min(block_size, d - s) for s in range(0, max(d, 1), block_size)
+    )
+
+
+def split_by_widths(data, widths) -> list:
+    """Slice the feature axis by explicit block widths."""
+    blocks, start = [], 0
+    for w in widths:
+        blocks.append(data[..., start : start + w])
+        start += w
+    return blocks
+
+
 def _split_blocks(data, block_size: int) -> list:
     if isinstance(data, (list, tuple)):
         return list(data)
-    d = data.shape[-1]
-    return [
-        data[..., s : min(s + block_size, d)] for s in range(0, d, block_size)
-    ]
+    return split_by_widths(data, block_widths(data.shape[-1], block_size))
 
 
 @treenode
@@ -288,14 +483,11 @@ class BlockLinearMapper(Transformer):
     block_size: int = static_field(default=4096)
 
     def _blocks_of(self, batch) -> list:
-        """Split by the fitted per-block widths (last block may be narrower)."""
+        """Split by the fitted per-block widths (last block may be
+        narrower) — the shared :func:`split_by_widths` boundary rule."""
         if isinstance(batch, (list, tuple)):
             return list(batch)
-        blocks, start = [], 0
-        for x in self.xs:
-            blocks.append(batch[..., start : start + x.shape[0]])
-            start += x.shape[0]
-        return blocks
+        return split_by_widths(batch, tuple(x.shape[0] for x in self.xs))
 
     def __call__(self, batch):
         return self._sum_blocks(tuple(self._blocks_of(batch)))
@@ -449,6 +641,66 @@ class BlockLeastSquaresEstimator(LabelEstimator):
                 )
         return models[:n_lam]
 
+    # -- streaming normal-equations protocol (fit_stats_*) ------------
+    # Same accumulated (AᵀA, AᵀB, Σa, Σb, n) state as the exact solver —
+    # the FULL (D, D) Gram carries every cross-block product BCD needs,
+    # so finalize runs the Gram-form pass loop (:func:`_bcd_fit_gram`)
+    # at D²·K per pass with the rows long gone. Memory: D² f32 state vs
+    # the materialized N·D features — the planner prices the trade.
+
+    def fit_stats_init(self, d: int, k: int) -> NormalEqState:
+        return normal_eq_init(d, k)
+
+    def fit_stats_update(
+        self, state, data, labels, n_valid=None, gram_fn=None
+    ) -> NormalEqState:
+        return normal_eq_update(
+            state, data, labels, n_valid, gram_fn, precision=self.precision
+        )
+
+    def _finalize_widths(self, state, widths) -> tuple[int, ...]:
+        d = state.ata.shape[0]
+        return tuple(widths) if widths else block_widths(d, self.block_size)
+
+    def fit_stats_finalize(self, state, widths=None) -> BlockLinearMapper:
+        """``widths`` pins the block boundaries to whatever the caller's
+        feature blocks were (a bank's last block may be narrower than
+        ``block_size``); default derives them from :func:`block_widths`
+        — the same rule ``_split_blocks`` uses, so the streamed fit and
+        the materialized fit can never disagree on block edges."""
+        return self.fit_sweep_finalize(state, [self.lam], widths=widths)[0]
+
+    def fit_sweep_finalize(
+        self, state, lams, widths=None
+    ) -> list[BlockLinearMapper]:
+        widths = self._finalize_widths(state, widths)
+        ata, atb, b_mean, a_mean, _ = normal_eq_finalize(state)
+        lams_arr = jnp.asarray(lams, jnp.float32)
+        with _matmul_precision(self.precision):
+            xs_l = _bcd_fit_gram(ata, atb, lams_arr, widths, self.num_iter)
+        means = tuple(split_by_widths(a_mean, widths))
+        offs = np.concatenate([[0], np.cumsum(widths)]).astype(int)
+        return [
+            BlockLinearMapper(
+                xs=tuple(
+                    xs_l[i, offs[j] : offs[j + 1]]
+                    for j in range(len(widths))
+                ),
+                b=b_mean,
+                means=means,
+                block_size=self.block_size,
+            )
+            for i in range(lams_arr.shape[0])
+        ]
+
+    @staticmethod
+    def fit_stats_flops_per_row(d: int, k: int) -> float:
+        return 2.0 * d * (d + k)
+
+    @staticmethod
+    def fit_stats_state_bytes(d: int, k: int) -> int:
+        return 4 * (d * d + d * k + 2 * d + 2 * k)
+
 
 def _block_stats(blocks: tuple, labels, n_valid):
     """Shared BCD preamble: row mask, label mean, per-block means,
@@ -547,6 +799,48 @@ def _bcd_fit(
 
     intercept = b_mean
     return tuple(xs), tuple(means), intercept
+
+
+@partial(jax.jit, static_argnames=("widths", "num_iter"))
+def _bcd_fit_gram(ata, atb, lams, widths: tuple, num_iter: int):
+    """Gram-form BCD: the identical fixed point as :func:`_bcd_fit`,
+    computed from the FULL centered normal-equation statistics instead
+    of the data. The data-form block update is
+    ``rhs_i = A_iᵀR + G_ii x_i`` with ``R = b_c − Σ_j A_j x_j``;
+    substituting, ``A_iᵀR = (AᵀB)_i − Σ_j G_ij x_j`` — every quantity
+    the pass loop needs lives in the (D, D) Gram, so a fit streamed
+    through :func:`normal_eq_update` never touches the rows again.
+    Returns (L, D, K) solutions, one per λ in ``lams``; per-pass work
+    is D²·K gemms, independent of N."""
+    f32 = ata.dtype
+    lams = lams.astype(f32)
+    offs = np.concatenate([[0], np.cumsum(widths)]).astype(int)
+    diag = [
+        ata[offs[i] : offs[i + 1], offs[i] : offs[i + 1]]
+        for i in range(len(widths))
+    ]
+
+    def solve_one(lam):
+        # factors are pass-invariant (same hoisting as _bcd_fit)
+        factors = [ridge_factor(g, lam) for g in diag]
+        x0 = jnp.zeros((ata.shape[0], atb.shape[-1]), f32)
+
+        def one_pass(_p, x):
+            for i in range(len(widths)):
+                o, o2 = offs[i], offs[i + 1]
+                # A_iᵀ R + G_ii x_i  ==  atb_i − G[i,:] x + G_ii x_i
+                rhs = (
+                    atb[o:o2]
+                    - ata[o:o2] @ x
+                    + diag[i] @ x[o:o2]
+                )
+                xi = ridge_solve_prefactored(factors[i], diag[i], rhs, lam)
+                x = x.at[o:o2].set(xi)
+            return x
+
+        return jax.lax.fori_loop(0, num_iter, one_pass, x0)
+
+    return jax.vmap(solve_one)(lams)
 
 
 @treenode

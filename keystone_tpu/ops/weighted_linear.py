@@ -46,6 +46,7 @@ from keystone_tpu.ops.linear import (
     _matmul_precision,
     _row_mask,
     _split_blocks,
+    block_widths,
     ridge_factor,
     ridge_solve,
     ridge_solve_prefactored,
@@ -136,6 +137,59 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         return BlockLinearMapper(
             xs=xs, b=b, means=None, block_size=self.block_size
         )
+
+    # -- streaming per-class stats protocol (fit_stats_*) -------------
+    # The weighted objective's sufficient statistics are the population
+    # Gram PLUS per-class Grams/sums (every per-class covariance,
+    # mean-difference outer product, and residual projection the BCD
+    # passes consume reconstructs from them) — so the fit streams like
+    # the plain solvers, at (C, D, D) state residency. The planner's
+    # fused-fit pass prices that state against the memory budget and
+    # falls back to the materialized fit when C·D² doesn't fit.
+
+    def fit_stats_init(self, d: int, c: int) -> "WeightedEqState":
+        return weighted_eq_init(d, c)
+
+    def fit_stats_update(
+        self, state, data, labels, n_valid=None, gram_fn=None
+    ) -> "WeightedEqState":
+        # gram_fn is accepted for protocol uniformity but unused: the
+        # per-class Grams gate the solve's conditioning and stay exact
+        return weighted_eq_update(
+            state, data, labels, n_valid, precision=self.precision
+        )
+
+    def fit_stats_finalize(self, state, widths=None) -> BlockLinearMapper:
+        d = state.ata.shape[0]
+        widths = (
+            tuple(widths) if widths else block_widths(d, self.block_size)
+        )
+        with _matmul_precision(self.precision):
+            xs_full, b = _weighted_gram_fit(
+                state,
+                widths,
+                self.num_iter,
+                self.lam,
+                self.mixture_weight,
+            )
+        offs = np.concatenate([[0], np.cumsum(widths)]).astype(int)
+        xs = tuple(
+            xs_full[offs[i] : offs[i + 1]] for i in range(len(widths))
+        )
+        return BlockLinearMapper(
+            xs=xs, b=b, means=None, block_size=self.block_size
+        )
+
+    @staticmethod
+    def fit_stats_flops_per_row(d: int, c: int) -> float:
+        # population Gram + AᵀY + the masked per-class Gram contraction
+        # (the C·d² einsum term dominates — the price of exact
+        # per-class covariances without a class-sorted row gather)
+        return 2.0 * d * (d + c) + 2.0 * c * d * d
+
+    @staticmethod
+    def fit_stats_state_bytes(d: int, c: int) -> int:
+        return 4 * (c * d * d + d * d + 2 * d * c + d + 2 * c)
 
 
 def _class_sorted_perm(
@@ -671,3 +725,188 @@ def _weighted_bcd_fit(
     for jm, x in zip(joint_means, xs):
         b = b - jnp.einsum("cd,dc->c", jm, x)
     return tuple(xs), b
+
+
+# ---------------------------------------------------------------------------
+# Streaming per-class statistics: the weighted fit's fit_stats protocol.
+#
+# Every quantity _weighted_bcd_fit derives from the rows — population
+# mean/covariance, per-class means/covariances, residual projections,
+# and their per-pass updates — is a function of the accumulated
+# (AᵀA, AᵀY, per-class AᵀA, per-class Σa, Σa, n_c, n) statistics:
+#
+#   pop_cov   = AᵀA/n − μμᵀ
+#   class_cov = AᵀA|_c /n_c − μ_c μ_cᵀ
+#   pop_xtr   = AᵀR/n          with R = (Y − jlm)·mask  →  (AᵀY − Σa·jlmᵀ)/n
+#   class_xtr = Σ_{j∈c} a_j r_own_j /n_c,  r_own init (1 − jlm_c)
+#
+# and a block-i BCD delta updates them in Gram form:
+#   pop_xtr   −= AᵀA[:, i] δ / n
+#   class_xtr −= AᵀA|_c[:, i] δ_c / n_c
+#   r_own_mean−= Σa|_c[i]·δ_c / n_c
+#   res_mean  −= Σa[i]·δ_c / n
+#
+# so the BCD pass loop runs entirely on statistics — the rows are gone.
+# Centered quantities use the subtraction form (the streaming trade the
+# dense path's comment warns about); the f32 state plus modest feature
+# scales keeps the drift inside the fused-fit tolerance, and the dense
+# path remains the reference for adversarial conditioning.
+
+
+@treenode
+class WeightedEqState:
+    """Running f32 per-class normal-equation statistics."""
+
+    ata: jnp.ndarray  # (D, D) Σ a aᵀ over valid rows
+    at_labels: jnp.ndarray  # (D, C) Σ a yᵀ (±1 indicator labels)
+    class_ata: jnp.ndarray  # (C, D, D) per-class Σ a aᵀ
+    class_sum: jnp.ndarray  # (C, D) per-class Σ a
+    sum_a: jnp.ndarray  # (D,)
+    n_c: jnp.ndarray  # (C,)
+    n: jnp.ndarray  # ()
+
+
+def weighted_eq_init(d: int, c: int) -> WeightedEqState:
+    f32 = jnp.float32
+    return WeightedEqState(
+        ata=jnp.zeros((d, d), f32),
+        at_labels=jnp.zeros((d, c), f32),
+        class_ata=jnp.zeros((c, d, d), f32),
+        class_sum=jnp.zeros((c, d), f32),
+        sum_a=jnp.zeros((d,), f32),
+        n_c=jnp.zeros((c,), f32),
+        n=jnp.zeros((), f32),
+    )
+
+
+@jax.jit
+def _weighted_eq_update(state, data, labels, n_valid):
+    from keystone_tpu.ops.linear import _concat_blocks
+
+    data = _concat_blocks(data)
+    f32 = jnp.float32
+    mask = _row_mask(data.shape[0], n_valid, f32)
+    a = data.astype(f32) * mask
+    y = labels.astype(f32) * mask
+    c = labels.shape[-1]
+    onehot = jax.nn.one_hot(jnp.argmax(labels, axis=-1), c, dtype=f32) * mask
+    return WeightedEqState(
+        ata=state.ata + a.T @ a,
+        at_labels=state.at_labels + a.T @ y,
+        class_ata=state.class_ata + jnp.einsum("nc,nd,ne->cde", onehot, a, a),
+        class_sum=state.class_sum + onehot.T @ a,
+        sum_a=state.sum_a + jnp.sum(a, 0),
+        n_c=state.n_c + jnp.sum(onehot, 0),
+        n=state.n + jnp.sum(mask),
+    )
+
+
+def weighted_eq_update(
+    state: WeightedEqState,
+    data,
+    labels,
+    n_valid=None,
+    precision: str | None = None,
+) -> WeightedEqState:
+    """Fold one (rows, D) chunk of ±1 indicator-labeled data into the
+    per-class statistics; pad rows masked out of every accumulator.
+    ``precision`` pins the matmul precision like the estimator's
+    materialized fit does (env fallback when None)."""
+    with _matmul_precision(precision):
+        return _weighted_eq_update(state, data, labels, n_valid)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("widths", "num_iter", "lam", "mixture_weight"),
+)
+def _weighted_gram_fit(
+    state: WeightedEqState,
+    widths: tuple,
+    num_iter: int,
+    lam: float,
+    mixture_weight: float,
+):
+    """Gram-form weighted BCD — the fixed point of
+    :func:`_weighted_bcd_fit`, computed from streamed statistics.
+    Per-class solves are dense batched (vmapped) ridge solves; per-pass
+    work is C·d_block² gemms + C solves, independent of N."""
+    w = mixture_weight
+    f32 = jnp.float32
+    d = state.ata.shape[0]
+    c = state.n_c.shape[0]
+    n = jnp.maximum(state.n, 1.0)
+    n_c_safe = jnp.maximum(state.n_c, 1.0)
+    offs = np.concatenate([[0], np.cumsum(widths)]).astype(int)
+
+    pop_mean = state.sum_a / n  # (D,)
+    class_mean = state.class_sum / n_c_safe[:, None]  # (C, D)
+    pop_cov = state.ata / n - jnp.outer(pop_mean, pop_mean)
+    class_cov = state.class_ata / n_c_safe[:, None, None] - jnp.einsum(
+        "cd,ce->cde", class_mean, class_mean
+    )
+    joint_mean = w * class_mean + (1 - w) * pop_mean  # (C, D)
+    md = class_mean - pop_mean  # (C, D)
+
+    # jointLabelMean + the x=0 residual statistics (labels are ±1
+    # indicators: Σ_j y_jc = 2n_c − n over valid rows; r_own = 1 − jlm)
+    jlm = 2 * w + 2 * (1 - w) * state.n_c / n - 1  # (C,)
+    pop_xtr = (state.at_labels - jnp.outer(state.sum_a, jlm)) / n  # (D, C)
+    class_xtr = (1.0 - jlm)[:, None] * class_mean  # (C, D)
+    r_own_mean = 1.0 - jlm  # (C,)
+    res_mean = (2 * state.n_c / n - 1.0) - jlm  # (C,)
+
+    # pass-invariant per-(block, class) systems + factors, built once
+    sys_factors = []
+    for i in range(len(widths)):
+        o, o2 = offs[i], offs[i + 1]
+        jxtx = (
+            (1 - w) * pop_cov[o:o2, o:o2][None]
+            + w * class_cov[:, o:o2, o:o2]
+            + w * (1 - w) * jnp.einsum(
+                "cd,ce->cde", md[:, o:o2], md[:, o:o2]
+            )
+        )  # (C, d_i, d_i)
+        fc, fs = jax.vmap(lambda m_: ridge_factor(m_, lam))(jxtx)
+        sys_factors.append((jxtx, fc, fs))
+
+    x0 = jnp.zeros((d, c), f32)
+
+    def one_pass(_p, carry):
+        x, pop_xtr, class_xtr, r_own_mean, res_mean = carry
+        for i in range(len(widths)):
+            o, o2 = offs[i], offs[i + 1]
+            jxtx, fc, fs = sys_factors[i]
+            mean_mix = (1 - w) * res_mean + w * r_own_mean  # (C,)
+            joint_xtr = (
+                (1 - w) * pop_xtr[o:o2].T
+                + w * class_xtr[:, o:o2]
+                - joint_mean[:, o:o2] * mean_mix[:, None]
+            )  # (C, d_i)
+            rhs = joint_xtr - lam * x[o:o2].T  # (C, d_i)
+            delta = jax.vmap(
+                lambda f_c, f_s, m_, r_: ridge_solve_prefactored(
+                    (f_c, f_s), m_, r_[:, None], lam
+                )[:, 0]
+            )(fc, fs, jxtx, rhs)  # (C, d_i)
+            delta_dc = delta.T  # (d_i, C)
+            x = x.at[o:o2].add(delta_dc)
+            # Gram-form residual-statistic updates (see module comment)
+            pop_xtr = pop_xtr - (state.ata[:, o:o2] @ delta_dc) / n
+            class_xtr = class_xtr - jnp.einsum(
+                "cDe,ec->cD", state.class_ata[:, :, o:o2], delta_dc
+            ) / n_c_safe[:, None]
+            r_own_mean = r_own_mean - jnp.einsum(
+                "cd,dc->c", state.class_sum[:, o:o2], delta_dc
+            ) / n_c_safe
+            res_mean = res_mean - (state.sum_a[o:o2] @ delta_dc) / n
+        return x, pop_xtr, class_xtr, r_own_mean, res_mean
+
+    x, *_ = jax.lax.fori_loop(
+        0,
+        num_iter,
+        one_pass,
+        (x0, pop_xtr, class_xtr, r_own_mean, res_mean),
+    )
+    b = jlm - jnp.einsum("cd,dc->c", joint_mean, x)
+    return x, b

@@ -135,11 +135,17 @@ def replicated_sharding(mesh: Mesh | None = None) -> NamedSharding | None:
 
 def data_sharding_fn(mesh: Mesh | None):
     """Per-chunk placement callable for the staging engine: maps a chunk
-    to its rank-matched data-axis sharding (None mesh → None, plain
-    placement). The ONE home of the chunk→spec rule."""
+    — a bare array OR a pytree of arrays (the fused fit stages
+    (data, labels) pairs) — to rank-matched data-axis sharding specs
+    per leaf (None mesh → None, plain placement). The ONE home of the
+    chunk→spec rule."""
     if mesh is None:
         return None
-    return lambda chunk: data_sharding(mesh, getattr(chunk, "ndim", 1))
+    import jax
+
+    return lambda chunk: jax.tree_util.tree_map(
+        lambda leaf: data_sharding(mesh, getattr(leaf, "ndim", 1)), chunk
+    )
 
 
 def data_axis_size(mesh: Mesh | None) -> int:

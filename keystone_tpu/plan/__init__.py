@@ -20,13 +20,17 @@ Entry points::
     out  = plan.execute(batch)                        # plan-aware run
     out  = execute(fitted_pipe, batch)                # one-shot form
     fitted = fit_shared([chainA, chainB], data, y)    # prefix paid once
+    fitted = fit_streaming(chained_est, x, y)         # fused streaming
+                                                      # normal-eq fit
 
 Env knobs: ``KEYSTONE_PLAN=1`` opts model entry points into planned
 execution; ``KEYSTONE_PLAN_BUDGET_MB`` caps resident cached
 intermediates (default 1024); ``KEYSTONE_STAGE_DEPTH`` overrides the
-double-buffered host→device staging depth (0 = synchronous). Every
-decision is observable: ``optimize`` events in the run log plus
-``plan_*`` / ``plan_transfer_*`` / ``plan_shard_*`` metrics counters.
+double-buffered host→device staging depth (0 = synchronous);
+``KEYSTONE_GRAM_OP`` / ``KEYSTONE_GRAM_INT8_MAX_ERR`` steer the fused
+fit's Gram-operator selection (:mod:`.fused_fit`). Every decision is
+observable: ``optimize`` events in the run log plus ``plan_*`` /
+``plan_transfer_*`` / ``plan_shard_*`` metrics counters.
 """
 
 from __future__ import annotations
@@ -43,6 +47,7 @@ from keystone_tpu.plan import executor as _executor
 from keystone_tpu.plan import passes as _passes
 from keystone_tpu.plan.ir import NodeCost, Plan, PlanNode, chain_from
 from keystone_tpu.plan.executor import apply_shared, fit_shared, run_plan
+from keystone_tpu.plan.fused_fit import fit_streaming, plan_fit
 
 ENV_ENABLE = "KEYSTONE_PLAN"
 ENV_BUDGET_MB = "KEYSTONE_PLAN_BUDGET_MB"
@@ -53,8 +58,10 @@ __all__ = [
     "PlanNode",
     "NodeCost",
     "plan_pipeline",
+    "plan_fit",
     "execute",
     "fit_shared",
+    "fit_streaming",
     "apply_shared",
     "run_plan",
     "enabled",

@@ -63,9 +63,39 @@ def _cifar_pipeline():
     return pipe, x
 
 
+def _mnist_fit_plan(chunk_size=None, budget_bytes=None):
+    """The fused streaming-fit plan for an MNIST-shaped chained fit:
+    featurizer bank → block least squares, absorbed into ONE
+    streaming_fit node with the Gram-operator decision recorded."""
+    import jax.numpy as jnp
+
+    from keystone_tpu import plan as plan_mod
+    from keystone_tpu.core.pipeline import ChainedLabelEstimator
+    from keystone_tpu.models.mnist_random_fft import FeaturizerBank
+    from keystone_tpu.ops.linear import BlockLeastSquaresEstimator
+    from keystone_tpu.ops.util import ClassLabelIndicators
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(512, 784)).astype(np.float32))
+    y = ClassLabelIndicators(num_classes=10)(
+        rng.integers(0, 10, size=512).astype(np.int32)
+    )
+    chain = ChainedLabelEstimator(
+        prefix=FeaturizerBank.create(num_ffts=2, block_size=1024, seed=0),
+        est=BlockLeastSquaresEstimator(block_size=1024, num_iter=1, lam=1.0),
+    )
+    return plan_mod.plan_fit(
+        chain, x, y, chunk_size=chunk_size, budget_bytes=budget_bytes
+    )
+
+
 BUILDERS = {
     "mnist-random-fft": _mnist_pipeline,
     "cifar-random-patch": _cifar_pipeline,
+}
+
+FIT_BUILDERS = {
+    "mnist-random-fft": _mnist_fit_plan,
 }
 
 
@@ -80,6 +110,12 @@ def main(argv: list[str] | None = None) -> None:
         ),
     )
     parser.add_argument("model", choices=sorted(BUILDERS))
+    parser.add_argument(
+        "--fit",
+        action="store_true",
+        help="plan the model's FIT path (fused streaming normal-equations "
+        "accumulation + Gram-operator choice) instead of its apply path",
+    )
     parser.add_argument(
         "--chunk-size", type=int, default=None, help="force executor chunk size"
     )
@@ -99,6 +135,26 @@ def main(argv: list[str] | None = None) -> None:
     args = parser.parse_args(argv)
 
     from keystone_tpu import plan as plan_mod
+
+    if args.fit:
+        if args.model not in FIT_BUILDERS:
+            raise SystemExit(
+                f"--fit supports: {', '.join(sorted(FIT_BUILDERS))}"
+            )
+        plan = FIT_BUILDERS[args.model](
+            chunk_size=args.chunk_size,
+            budget_bytes=(
+                None
+                if args.budget_mb is None
+                else int(args.budget_mb * 2**20)
+            ),
+        )
+        print(
+            f"{args.model} fit (sampled on {plan.rows} rows, plan only — "
+            "not executed)"
+        )
+        print(plan.explain())
+        return
 
     pipe, probe = BUILDERS[args.model]()
     plan = plan_mod.plan_pipeline(

@@ -60,6 +60,30 @@ def device_peaks(
     return DEVICE_PEAKS["cpu"]
 
 
+# int8 MXU rate relative to bf16, per device kind — the Gram-operator
+# selection's cost basis (plan/fused_fit.py). TPU int8 passes run ~2×
+# the bf16 rate; CPUs (and unknown chips) get 1.0, so the planner never
+# chooses the quantized Gram where it can't win.
+INT8_GRAM_SPEEDUP: dict[str, float] = {
+    "cpu": 1.0,
+    "v4": 2.0,
+    "v5 lite": 2.0,
+    "v5e": 2.0,
+    "v5p": 2.0,
+}
+
+
+def int8_gram_speedup(device_kind: str | None) -> float:
+    """int8-vs-bf16 rate for a ``device_kind`` (substring match,
+    case-insensitive); unknown kinds report 1.0 (no advantage)."""
+    if device_kind:
+        kind = device_kind.lower()
+        for key, speedup in INT8_GRAM_SPEEDUP.items():
+            if key in kind:
+                return speedup
+    return 1.0
+
+
 def peak_flops_for(device_kind: str | None) -> float | None:
     """bf16 peak FLOP/s for a known accelerator ``device_kind``, or None
     (CPU, new chip generations) — the report's roofline basis."""

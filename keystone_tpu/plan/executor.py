@@ -32,6 +32,7 @@ once.
 
 from __future__ import annotations
 
+import functools
 import itertools
 import time
 from typing import Any, Callable, Sequence
@@ -40,7 +41,7 @@ import jax
 import numpy as np
 
 from keystone_tpu.core.batching import apply_in_chunks, pad_to_chunk
-from keystone_tpu.core.staging import free_buffers, run_staged
+from keystone_tpu.core.staging import fold_staged, free_buffers, run_staged
 from keystone_tpu.core.pipeline import (
     Cacher,
     ChainedEstimator,
@@ -483,6 +484,132 @@ def apply_shared(
     import jax.numpy as jnp
 
     return [jnp.concatenate(o, axis=0) for o in outs]
+
+
+@functools.partial(jax.jit, static_argnames=("gram_fn",))
+def _fused_fit_update(prefix, est, state, chunk, labels, valid, gram_fn):
+    """One fused featurize→accumulate step: the whole prefix AND the
+    normal-equation update trace as ONE XLA program, so the featurized
+    chunk lives only inside the fusion — never as a host-visible
+    intermediate. ``prefix``/``est``/``state`` are pytrees (one
+    compilation per structure; every chunk hits the same executable)."""
+    feats = prefix(chunk) if prefix.nodes else chunk
+    return est.fit_stats_update(
+        state, feats, labels, n_valid=valid, gram_fn=gram_fn
+    )
+
+
+def fit_stream(plan: Plan, data: Any, labels: Any, *, n_valid=None):
+    """Execute a fused streaming-fit plan: drive staged (data, labels)
+    chunks through the sink's ``featurize → fit_stats_update`` step on
+    the shared staging engine (:func:`keystone_tpu.core.staging.
+    fold_staged` — chunk k+1's host→device transfer overlaps chunk k's
+    accumulate), returning the accumulated state for the caller's
+    ``fit_stats_finalize``.
+
+    Pad rows — ragged tail or shard rounding — are masked out of the
+    statistics via each chunk's ``n_valid``. Emits one ``source=
+    "solver"`` telemetry row (rows/s, chunks, cost-priced MFU from the
+    fused node's per-row FLOPs) plus ``plan_fused_fit*`` counters.
+    """
+    from keystone_tpu.plan.fused_fit import StreamingFitSink
+
+    # a fallback plan (empty prefix) or a partially fused one (unfused
+    # nodes before the sink) must fail loudly — streaming past an
+    # unabsorbed featurize node would silently fit the wrong features
+    if (
+        plan.fit is None
+        or not plan.fit.fused
+        or len(plan.prefix) != 1
+        or not isinstance(plan.prefix[-1].op, StreamingFitSink)
+    ):
+        raise ValueError("fit_stream needs a fully fused streaming-fit plan")
+    sink = plan.prefix[-1].op
+    reg = _metrics.get_registry()
+    est = sink.est
+    prefix_pipe = Pipeline(nodes=tuple(sink.prefix))
+    gram_fn = None
+    if sink.gram == "int8":
+        from keystone_tpu.ops.gram import ata_int8
+
+        gram_fn = ata_int8
+
+    n = int(data.shape[0])
+    n_ok = int(n_valid) if n_valid is not None else n
+    chunk = int(plan.chunk_size or n)
+    # data_sharding_fn maps the staged (data, labels) pair per leaf
+    sharding = _data_sharding(plan)
+    if sharding is not None:
+        from keystone_tpu.parallel.mesh import data_axis_size
+
+        if chunk % data_axis_size(plan.mesh):
+            sharding = None  # planner rounds; this guards
+
+    def chunks():
+        for start in range(0, n, chunk):
+            a, va = pad_to_chunk(data[start : start + chunk], chunk)
+            b, _ = pad_to_chunk(labels[start : start + chunk], chunk)
+            yield (a, b), max(0, min(n_ok - start, va))
+
+    import jax.numpy as jnp
+
+    def update(state, staged, valid):
+        a, b = staged
+        with _fit_precision(est):
+            return _fused_fit_update(
+                prefix_pipe, est, state, a, b, jnp.int32(valid), gram_fn
+            )
+
+    steplog = _telemetry.active_step_log()
+    span_log = _spans.active_span_log()
+    n_chunks = -(-n // chunk) if n else 0
+    t0 = time.perf_counter()
+    # structural span: the staging engine's h2d / device-wait children
+    # carry the classified time, same shape as a chunked plan segment
+    with _spans.span(
+        "plan.fit_stream",
+        log=span_log,
+        bucket=None,
+        rows=n_ok,
+        chunks=n_chunks,
+        gram=sink.gram,
+    ):
+        state = fold_staged(
+            chunks(),
+            update,
+            est.fit_stats_init(sink.d, sink.k),
+            sharding=sharding,
+            stage_depth=plan.stage_depth,
+            inflight=max(plan.prefetch, 0),
+        )
+    wall = time.perf_counter() - t0
+    reg.counter("plan_fused_fits").inc()
+    reg.counter("plan_fused_fit_chunks").inc(n_chunks)
+    if steplog is not None:
+        flops = plan.prefix[-1].cost.flops * n
+        steplog.step(
+            step=next(_stream_seq),
+            source="solver",
+            wall_s=wall,
+            flops=flops or None,
+            rows=n_ok,
+            rows_per_s=round(n_ok / wall, 3) if wall else None,
+            chunks=n_chunks,
+            chunk_size=chunk,
+            stage_depth=plan.stage_depth,
+            gram=sink.gram,
+            estimator=type(est).__name__,
+        )
+    return state
+
+
+def _fit_precision(est):
+    """The estimator-pinned matmul precision (falling back to the
+    ``KEYSTONE_MATMUL_PRECISION`` env knob) — the fused step's chunk
+    Grams must run at the same precision the materialized fit would."""
+    from keystone_tpu.ops.linear import _matmul_precision
+
+    return _matmul_precision(getattr(est, "precision", None))
 
 
 def serve_stream(

@@ -1,0 +1,370 @@
+"""Fused streaming normal-equations fit: the planner's solver fast path.
+
+The standing MFU gap (BENCH_TPU_LAST: solver 0.089 vs lm_train 0.387)
+is an execution-shape problem, not a kernel problem: the classic fit
+materializes the whole feature matrix through a host dispatch boundary
+before the solver ever contracts it. This module closes the gap the
+KeystoneML way — as OPERATOR SELECTION over the plan IR:
+
+- a fit whose estimator speaks the ``fit_stats_init/update/finalize``
+  protocol (:mod:`keystone_tpu.ops.linear`,
+  :mod:`keystone_tpu.ops.weighted_linear`) is planned as a
+  :class:`StreamingFitSink` node at the end of its featurize chain;
+- the registered ``fuse_streaming_fit`` rewrite rule folds every
+  row-wise featurize node INTO the sink (applied to fixpoint, the
+  whole prefix disappears into one node), so the executor drives staged
+  chunks through ``featurize_chunk → accumulate_gram`` as ONE jitted
+  segment — features never materialize, the planner records
+  ``materialize_features=False`` and the ``plan_fit_materialized``
+  counter stays untouched;
+- the Gram operator is the planner's choice: the int8 Pallas ``AᵀA``
+  (:func:`keystone_tpu.ops.gram.ata_int8`) is selected only when the
+  probe's quantization error is under threshold AND the device's int8
+  rate beats fp32 — otherwise the exact fp32 Gram, with a
+  ``fit_operator`` decision in the plan/event log either way;
+- chunk size, staging depth, and sharded dispatch reuse the existing
+  passes (:func:`keystone_tpu.plan.passes.choose_chunk_size` /
+  ``choose_staging``), so a fused fit streams through the same
+  double-buffered engine as every other chunked pass.
+
+Entry points::
+
+    fitted = fit_streaming(chained_label_est, x, y, n_valid=n)
+    plan   = plan_fit(chained_label_est, x, y)   # plan only
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from keystone_tpu.core.pipeline import (
+    ChainedLabelEstimator,
+    Pipeline,
+    Transformer,
+)
+from keystone_tpu.observe import events as _events
+from keystone_tpu.observe import metrics as _metrics
+from keystone_tpu.plan.ir import NodeCost, Plan, PlanNode
+from keystone_tpu.plan.passes import rewrite_rule
+
+
+@dataclasses.dataclass(frozen=True)
+class StreamingFitSink:
+    """The plan-IR fit consumer: an estimator speaking the fit_stats
+    protocol plus the row-wise featurize prefix fused into it. Frozen —
+    the rewrite rule grows the prefix by replacement, never mutation."""
+
+    est: Any
+    d: int  # feature width the accumulated state covers
+    k: int  # label width
+    widths: tuple | None = None  # feature-block boundaries (bank output)
+    gram: str = "fp32"  # planner-chosen Gram operator
+    prefix: tuple = ()  # row-wise transformers fused in front
+
+    @property
+    def name(self) -> str:
+        tail = type(self.est).__name__
+        if self.prefix:
+            return f"streaming_fit[{len(self.prefix)}+{tail}]"
+        return f"streaming_fit[{tail}]"
+
+    def __repr__(self) -> str:
+        return self.name
+
+
+@rewrite_rule("fuse_streaming_fit", window=2)
+def _fuse_streaming_fit(node, sink):
+    """Fold one row-wise transformer into the streaming-fit sink. The
+    planner applies the rule walk to fixpoint, so a whole featurize
+    prefix collapses into the sink one node per walk — each absorption
+    is its own recorded rewrite decision."""
+    if not isinstance(sink, StreamingFitSink):
+        return None
+    from keystone_tpu.plan.executor import _chunkable_node
+
+    if not isinstance(node, Transformer) or not _chunkable_node(node):
+        return None
+    return dataclasses.replace(sink, prefix=(node,) + sink.prefix)
+
+
+@dataclasses.dataclass
+class FitPlanInfo:
+    """What the fit planner decided — carried on ``Plan.fit``."""
+
+    fused: bool
+    reason: str = ""
+    d: int = 0
+    k: int = 0
+    widths: tuple | None = None
+    gram: str = "fp32"
+    quant_error: float | None = None
+    n_valid: int | None = None
+
+
+def _supports_protocol(est: Any) -> bool:
+    return all(
+        hasattr(est, m)
+        for m in ("fit_stats_init", "fit_stats_update", "fit_stats_finalize")
+    )
+
+
+def _feature_shape(feats: Any) -> tuple[int, tuple | None]:
+    """(total width, per-block widths or None) of a featurize output."""
+    if isinstance(feats, (list, tuple)):
+        widths = tuple(int(b.shape[-1]) for b in feats)
+        return sum(widths), widths
+    return int(feats.shape[-1]), None
+
+
+def _hstack(feats: Any):
+    import jax.numpy as jnp
+    import numpy as np
+
+    if isinstance(feats, (list, tuple)):
+        return jnp.concatenate([jnp.asarray(b) for b in feats], axis=-1)
+    return np.asarray(feats)
+
+
+def _choose_gram(
+    plan: Plan, est: Any, probe_feats: Any, requested: str | None
+) -> tuple[str, float | None]:
+    """Operator selection for the Gram accumulation: int8 only when the
+    request (arg > ``KEYSTONE_GRAM_OP``) allows it, the estimator takes
+    a ``gram_fn`` (the weighted solver's per-class Grams stay exact),
+    the probe's quantization error clears the threshold, and the
+    device's int8 rate actually beats fp32. Every branch records the
+    same ``fit_operator`` decision shape."""
+    from keystone_tpu.ops import gram as _gram
+    from keystone_tpu.plan.costs import int8_gram_speedup
+
+    request = (requested or _gram.gram_op_request()).lower()
+    from keystone_tpu.ops.weighted_linear import (
+        BlockWeightedLeastSquaresEstimator,
+    )
+
+    exact_only = isinstance(est, BlockWeightedLeastSquaresEstimator)
+    threshold = _gram.int8_error_threshold()
+    speedup = int8_gram_speedup(plan.device_kind)
+    err: float | None = None
+    if request == "fp32" or exact_only:
+        op, reason = "fp32", (
+            "exact_per_class_grams" if exact_only else "requested"
+        )
+    else:
+        try:
+            import numpy as np
+
+            # gate on the operand the operator will actually see: the
+            # update quantizes CENTERED chunks, and centering can turn
+            # a benign column (big common offset, small spread) into a
+            # heavy-tailed one the int8 codes destroy
+            probe = np.asarray(_hstack(probe_feats), np.float32)
+            err = _gram.gram_quantization_error(probe - probe.mean(axis=0))
+        except Exception:  # noqa: BLE001 — unprobeable features stay exact
+            err = None
+        if request == "int8":
+            op, reason = "int8", "requested"
+        elif err is not None and err <= threshold and speedup > 1.0:
+            op, reason = "int8", "cost_model"
+        elif err is not None and err > threshold:
+            op, reason = "fp32", "quantization_error"
+        else:
+            op, reason = "fp32", "no_int8_advantage"
+    plan.decide(
+        "fit_operator",
+        op=op,
+        reason=reason,
+        quantization_error=round(err, 6) if err is not None else None,
+        threshold=threshold,
+        int8_speedup=speedup,
+    )
+    _metrics.get_registry().counter("plan_fit_operator", op=op).inc()
+    return op, err
+
+
+def plan_fit(
+    chain: ChainedLabelEstimator,
+    data: Any,
+    labels: Any,
+    *,
+    n_valid: int | None = None,
+    chunk_size: int | None = None,
+    mesh: Any = None,
+    stage_depth: int | None = None,
+    budget_bytes: int | None = None,
+    sample: Any | None = None,
+    gram: str | None = None,
+    prefetch: int = 2,
+) -> Plan:
+    """Build the fused streaming-fit plan for a chained label fit.
+
+    The plan either fully fuses (one :class:`StreamingFitSink` node —
+    the executor streams chunks, features never materialize) or records
+    why it can't (``fit_fallback`` decision: estimator without the
+    protocol, a non-row-wise prefix node, state over budget, an
+    unprobeable prefix) — :func:`fit_streaming` then takes the classic
+    materialized path and counts it.
+    """
+    from keystone_tpu import plan as plan_mod
+    from keystone_tpu.plan import costs as _costs
+    from keystone_tpu.plan import passes as _passes
+    from keystone_tpu.plan.executor import _prefix_nodes
+    from keystone_tpu.parallel.mesh import current_mesh
+
+    est = chain.est
+    prefix_nodes = _prefix_nodes(chain)
+    plan = Plan(
+        prefix=[],
+        budget_bytes=(
+            plan_mod.default_budget_bytes()
+            if budget_bytes is None
+            else budget_bytes
+        ),
+        device_kind=plan_mod._device_kind(),
+        prefetch=prefetch,
+        mesh=mesh if mesh is not None else current_mesh(),
+    )
+    n_rows = _costs._rows(data)
+    info = FitPlanInfo(fused=False, n_valid=n_valid)
+    plan.fit = info
+
+    if not _supports_protocol(est):
+        plan.decide("fit_fallback", reason="no_fit_stats_protocol")
+        _passes.emit_plan(plan)
+        return plan
+
+    probe = _costs.slice_probe(sample if sample is not None else data)
+    prefix_pipe = Pipeline.of(*prefix_nodes)
+    try:
+        probe_feats = prefix_pipe(probe)
+    except Exception:  # noqa: BLE001 — a prefix the probe can't drive
+        plan.decide("fit_fallback", reason="unprobeable_prefix")
+        _passes.emit_plan(plan)
+        return plan
+    d, widths = _feature_shape(probe_feats)
+    k = int(labels.shape[-1])
+    info.d, info.k, info.widths = d, k, widths
+
+    # state-residency guard: the accumulated stats must themselves fit
+    # (the weighted solver's per-class Grams are C·D² — at real ImageNet
+    # scale that loses to materializing, and the planner must say so)
+    state_bytes = int(est.fit_stats_state_bytes(d, k))
+    if state_bytes > plan.budget_bytes:
+        plan.decide(
+            "fit_fallback",
+            reason="state_over_budget",
+            state_bytes=state_bytes,
+            budget_bytes=plan.budget_bytes,
+        )
+        _passes.emit_plan(plan)
+        return plan
+
+    chain_nodes = [
+        PlanNode(label=_events.node_label(node, i), op=node)
+        for i, node in enumerate(prefix_pipe.nodes)
+    ]
+    _costs.attach(chain_nodes, probe)
+    sink = StreamingFitSink(est=est, d=d, k=k, widths=widths)
+    sink_cost = NodeCost(
+        flops=float(est.fit_stats_flops_per_row(d, k)),
+        peak_bytes=4.0 * d,  # the staged f32 feature row is the
+        # chunk-sizing unit; the state is constant residency, priced
+        # separately above
+        input_bytes=4.0 * d,
+        source="modeled",
+    )
+    chain_nodes.append(
+        PlanNode(
+            label=_events.node_label(sink, len(chain_nodes)),
+            op=sink,
+            cost=sink_cost,
+        )
+    )
+    plan.prefix = chain_nodes
+    plan.rows = _costs._rows(probe)
+
+    # rewrite to fixpoint: each walk folds one more prefix node into the
+    # sink (and lets every other registered rule — conv fusion etc. —
+    # fire on the not-yet-absorbed prefix first)
+    for _ in range(len(chain_nodes) + 1):
+        before = len(plan.decisions)
+        _passes.select_operators(plan)
+        if len(plan.decisions) == before:
+            break
+
+    fused_sink = (
+        plan.prefix[-1].op
+        if plan.prefix and isinstance(plan.prefix[-1].op, StreamingFitSink)
+        else None
+    )
+    if len(plan.prefix) != 1 or fused_sink is None:
+        plan.decide(
+            "fit_fallback",
+            reason="non_rowwise_prefix",
+            unfused_nodes=[pn.label for pn in plan.prefix[:-1]],
+        )
+        _passes.emit_plan(plan)
+        return plan
+
+    op, err = _choose_gram(plan, est, probe_feats, gram)
+    fused_sink = dataclasses.replace(fused_sink, gram=op)
+    plan.prefix[-1].op = fused_sink
+    info.fused, info.gram, info.quant_error = True, op, err
+
+    _passes.choose_chunk_size(
+        plan, n_rows, requested=chunk_size, shards=plan_mod._shards(plan)
+    )
+    if plan.chunk_size is None and n_rows > _DEFAULT_FIT_CHUNK:
+        # no cost basis for a choice, but an unchunked fused fit would
+        # stage the whole batch at once — bound it anyway
+        plan.chunk_size = _DEFAULT_FIT_CHUNK
+        plan.decide("chunk", size=plan.chunk_size, source="fit_default")
+    _passes.choose_staging(plan, n_rows, requested_depth=stage_depth)
+    plan.decide(
+        "fuse_fit",
+        nodes_fused=len(fused_sink.prefix),
+        materialize_features=False,
+        d=d,
+        k=k,
+        state_bytes=state_bytes,
+        gram=op,
+    )
+    _passes.emit_plan(plan)
+    return plan
+
+
+_DEFAULT_FIT_CHUNK = 8192
+
+
+def fit_streaming(
+    chain: ChainedLabelEstimator,
+    data: Any,
+    labels: Any,
+    *,
+    n_valid: int | None = None,
+    return_plan: bool = False,
+    **kw: Any,
+):
+    """Fit a chained label estimator through the planned fused
+    streaming path; returns the fitted :class:`Pipeline` (identical
+    contract to ``chain.fit``). When the plan can't fuse — estimator
+    without the protocol, non-row-wise prefix, state over budget — the
+    classic materialized fit runs instead, with the fallback recorded
+    as a plan decision and the ``plan_fit_materialized`` counter (the
+    fused path never touches it)."""
+    plan = plan_fit(chain, data, labels, n_valid=n_valid, **kw)
+    reg = _metrics.get_registry()
+    info: FitPlanInfo = plan.fit
+    if not info.fused:
+        reg.counter("plan_fit_materialized").inc()
+        fit_kw = {} if n_valid is None else {"n_valid": n_valid}
+        fitted = chain.fit(data, labels, **fit_kw)
+        return (fitted, plan) if return_plan else fitted
+
+    from keystone_tpu.plan import executor as _executor
+
+    state = _executor.fit_stream(plan, data, labels, n_valid=n_valid)
+    model = chain.est.fit_stats_finalize(state, widths=info.widths)
+    fitted = Pipeline.of(chain.prefix, model)
+    return (fitted, plan) if return_plan else fitted

@@ -134,6 +134,7 @@ class Plan:
     mesh: Any = None  # jax Mesh for sharded dispatch (None — single device)
     shard: bool = False  # planner chose data-axis sharded dispatch
     stage_depth: int = 2  # staged host→device chunks kept in flight
+    fit: Any = None  # FitPlanInfo for a fused streaming fit (fused_fit)
     decisions: list[dict] = dataclasses.field(default_factory=list)
 
     def decide(self, action: str, **fields: Any) -> dict:
@@ -185,6 +186,17 @@ class Plan:
                 f" {pn.reuse:>5} {'yes' if pn.materialize else '-':>5}"
             )
 
+        if self.fit is not None:
+            f = self.fit
+            lines.insert(
+                1,
+                f"  fit: {'fused streaming' if f.fused else 'materialized'}"
+                + (
+                    f"  d={f.d} k={f.k} gram={f.gram}"
+                    if f.fused
+                    else f"  ({f.reason or 'see decisions'})"
+                ),
+            )
         for i, pn in enumerate(self.prefix):
             row(i, pn)
         for b, branch in enumerate(self.branches):

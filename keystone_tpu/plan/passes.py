@@ -65,10 +65,12 @@ def registered_rules() -> tuple[RewriteRule, ...]:
 
 
 def _ensure_rules_loaded() -> None:
-    # the conv-chain rule lives with its node definitions in core.fusion;
-    # importing it here (lazily, to dodge the core→plan→core cycle at
+    # rules live with their node definitions — the conv-chain rule in
+    # core.fusion, the streaming-fit absorption rule in plan.fused_fit;
+    # importing them here (lazily, to dodge the core→plan→core cycle at
     # module import) guarantees registration before any rewrite walk
     import keystone_tpu.core.fusion  # noqa: F401
+    import keystone_tpu.plan.fused_fit  # noqa: F401
 
 
 def rewrite_nodes(nodes: Sequence[Any]) -> tuple[list[Any], list[dict]]:
