@@ -1013,6 +1013,99 @@ def bench_goodput() -> dict:
     return out
 
 
+def bench_refit_latency(
+    n_base: int | None = None,
+    chunk_rows: int | None = None,
+    d_feats: int | None = None,
+) -> dict:
+    """Online-learning economics record (learn/ subsystem): wall for
+    fold+finalize+swap of ONE new labeled chunk into accumulated
+    streaming-fit state vs a full from-scratch retrain on the union
+    corpus. The incremental path touches only the new rows (O(chunk·D²)
+    fold + O(D³) finalize); the full path re-featurizes everything —
+    the ratio is the whole point of the refit daemon. Runs on the CPU
+    fallback too."""
+    import tempfile
+
+    import jax
+
+    from keystone_tpu.core.pipeline import ChainedLabelEstimator, Pipeline
+    from keystone_tpu.core.serialization import save_fitted
+    from keystone_tpu.learn.swap import ModelSwapper
+    from keystone_tpu.ops.linear import LinearMapEstimator
+    from keystone_tpu.ops.stats import CosineRandomFeatures
+    from keystone_tpu.ops.util import ClassLabelIndicators
+    from keystone_tpu.plan import executor as _plan_exec
+    from keystone_tpu.plan.fused_fit import plan_fit
+    from keystone_tpu.serve.export import ExportedApply
+    from keystone_tpu.serve.server import ServeApp
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    n0 = n_base or (32_768 if on_cpu else 262_144)
+    m = chunk_rows or 4096
+    d_in, k = 128, 10
+    d = d_feats or (256 if on_cpu else 2048)
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(n0 + m, d_in)).astype(np.float32)
+    y = ClassLabelIndicators(num_classes=k)(
+        rng.integers(0, k, size=n0 + m).astype(np.int32)
+    )
+    y = np.asarray(y)
+    feat = CosineRandomFeatures.create(d_in, d, jax.random.key(3))
+    est = LinearMapEstimator(lam=1.0)
+    chain = ChainedLabelEstimator(prefix=feat, est=est)
+    plan = plan_fit(chain, x[:n0], y[:n0], chunk_size=4096)
+    base_state = _plan_exec.fit_stream(plan, x[:n0], y[:n0])
+    jax.block_until_ready(base_state.ata)
+
+    def incremental():
+        st = _plan_exec.fit_stream(
+            plan, x[n0:], y[n0:], init_state=base_state
+        )
+        return est.fit_stats_finalize(st, widths=plan.fit.widths)
+
+    def full_retrain():
+        st = _plan_exec.fit_stream(plan, x, y)
+        return est.fit_stats_finalize(st, widths=plan.fit.widths)
+
+    inc_s = _timed(lambda: incremental().x, iters=3)
+    full_s = _timed(lambda: full_retrain().x, iters=3)
+
+    # the swap leg: publish the refreshed model and hot-swap it into a
+    # live ServeApp (AOT re-export off the warm compile cache included
+    # — that IS the swap cost a server pays)
+    model = incremental()
+    pipe = Pipeline.of(feat, model)
+    app = ServeApp(
+        exported=ExportedApply(
+            pipe, x[:1], buckets=(8,), optimize=False
+        ),
+        deadline_ms=5.0,
+        model_version="base",
+    )
+    swapper = ModelSwapper(app)
+    app.swapper = swapper
+    try:
+        with tempfile.TemporaryDirectory() as tmp:
+            path = os.path.join(tmp, "refreshed.kst")
+            save_fitted(pipe, path, version="refreshed")
+            t0 = time.perf_counter()
+            swapper.swap_to_path(path)
+            swap_s = time.perf_counter() - t0
+    finally:
+        app.shutdown()
+    return {
+        "n_base_rows": n0,
+        "chunk_rows": m,
+        "d_features": d,
+        "fold_finalize_s": round(inc_s, 4),
+        "full_retrain_s": round(full_s, 4),
+        "incremental_vs_full": round(full_s / inc_s, 2),
+        "swap_s": round(swap_s, 4),
+        "e2e_refresh_s": round(inc_s + swap_s, 4),
+    }
+
+
 def bench_serve_latency(
     n_requests: int = 48,
     fit_n: int = 512,
@@ -1457,6 +1550,15 @@ def main() -> None:
         result["solver_mfu"] = bench_solver_mfu()
     except Exception as e:  # noqa: BLE001 — same contract as above
         result["solver_mfu"] = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+    # online-learning record (learn/ subsystem): fold+finalize+swap of
+    # one new chunk vs full retrain from scratch — the refit daemon's
+    # economics, runs on the CPU fallback too
+    try:
+        result["refit_latency"] = bench_refit_latency()
+    except Exception as e:  # noqa: BLE001 — same contract as above
+        result["refit_latency"] = {
+            "error": f"{type(e).__name__}: {str(e)[:200]}"
+        }
     # per-node operator breakdown (observe subsystem): wall time per
     # pipeline node plus compiler-modeled FLOPs/bytes when available
     result["mnist_per_node"] = mnist.get("per_node", {})

@@ -58,6 +58,7 @@ COMMANDS = {
     "plan": "keystone_tpu.plan.cli",
     "supervise": "keystone_tpu.resilience.supervisor",
     "serve": "keystone_tpu.serve.server",
+    "refit": "keystone_tpu.learn.refit",
 }
 
 
@@ -104,7 +105,10 @@ def main(argv: list[str] | None = None) -> None:
             f" prints the cost-based planner's chosen plan without executing;\n"
             f" `supervise -- CMD` relaunches a multihost job on host loss —\n"
             f" see `supervise --help`; `serve <model> [--port N]` serves a\n"
-            f" fitted pipeline or LM over HTTP/JSON — see `serve --help`)"
+            f" fitted pipeline or LM over HTTP/JSON — see `serve --help`;\n"
+            f" `refit <state> --watch DIR` folds live labeled chunks into\n"
+            f" streaming-fit state and republishes versioned models — see\n"
+            f" `refit --help`)"
         )
     if argv[0] in COMMANDS:
         import importlib

@@ -23,6 +23,8 @@ Two formats:
 
 from __future__ import annotations
 
+import contextlib
+import os
 import pickle
 
 import jax
@@ -30,6 +32,27 @@ import numpy as np
 
 _MAGIC = b"KSTP1\n"
 _MAGIC_FITTED = b"KSTF1\n"
+
+
+@contextlib.contextmanager
+def atomic_write(path: str):
+    """Write-to-temp + ``os.replace`` in the target's own directory, so
+    any concurrent reader — the refit watcher tailing a state file, the
+    reload endpoint loading the pipeline a daemon just republished —
+    sees either the old complete artifact or the new complete artifact,
+    never a torn one. Yields the open binary file handle; the replace
+    happens only when the body completes (a failed write leaves the old
+    file untouched and removes the temp)."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            yield f
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
 
 
 class PipelineSpecError(ValueError):
@@ -47,9 +70,10 @@ def _to_host(node):
 
 
 def save_pipeline(node, path: str) -> None:
-    """Persist a fitted Transformer/Pipeline (any pytree node) to ``path``."""
+    """Persist a fitted Transformer/Pipeline (any pytree node) to ``path``
+    (atomically — see :func:`atomic_write`)."""
     host = _to_host(node)
-    with open(path, "wb") as f:
+    with atomic_write(path) as f:
         f.write(_MAGIC)
         pickle.dump(host, f, protocol=pickle.HIGHEST_PROTOCOL)
 
@@ -113,7 +137,7 @@ def save_fitted(node, path: str, **meta) -> dict:
     Returns the spec that was written."""
     spec = pipeline_spec(node)
     payload = {"spec": spec, "meta": meta, "tree": _to_host(node)}
-    with open(path, "wb") as f:
+    with atomic_write(path) as f:
         f.write(_MAGIC_FITTED)
         pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
     return spec

@@ -1,0 +1,56 @@
+"""Online learning: the streaming-fit state becomes a live model.
+
+KeystoneML fits once and stops; the fit protocol
+(``fit_stats_init/update/finalize`` in :mod:`keystone_tpu.ops.linear` /
+:mod:`keystone_tpu.ops.weighted_linear`) is already an *incremental
+learner* — running (AᵀA, AᵀB, μ, n) statistics that fold new labeled
+chunks in O(chunk·D²) and re-finalize in O(D³) without ever revisiting
+old rows. This package is the production loop KeystoneML never closed:
+train → serve → observe → retrain, on mergeable sufficient statistics
+instead of a parameter server.
+
+- :mod:`.merge` — the missing third verb of the fit protocol:
+  ``fit_stats_merge`` (commutative/associative Chan pairwise merge for
+  :class:`~keystone_tpu.ops.linear.NormalEqState`, additive for the
+  per-class :class:`~keystone_tpu.ops.weighted_linear.WeightedEqState`),
+  plus digest-checked ``save_fit_state`` / ``load_fit_state`` so
+  accumulated statistics persist across runs and merge across hosts
+  over the coordination-service KV channel.
+- :mod:`.refit` — the refit daemon (``python -m keystone_tpu refit
+  <state> --watch <dir>``): tails a labeled-chunk stream with persisted
+  offsets (at-least-once), folds each chunk through the fused
+  featurize+accumulate segment
+  (:func:`keystone_tpu.plan.executor.fit_stream`), re-finalizes, and
+  publishes a versioned fitted pipeline via
+  :func:`keystone_tpu.core.serialization.save_fitted`.
+- :mod:`.swap` — atomic hot-swap of the running server's model under
+  traffic: ``POST /admin/reload`` (and SIGHUP) loads the candidate
+  through the serialization spec check, rebuilds the AOT bucket
+  executables off the warm compile cache, and swaps the handler with
+  zero dropped requests; a failed candidate rolls back loudly
+  (``serve.swap_fail`` drills it).
+- :mod:`.shadow` — shadow A/B promotion: primary serves, the candidate
+  scores sampled requests in shadow, per-request divergence lands in
+  spans/metrics, and promotion is gated on the divergence threshold
+  plus ``observe/health.py`` feature-drift alerts (incoming feature
+  distribution vs the state's accumulated means).
+"""
+
+from __future__ import annotations
+
+from keystone_tpu.learn.merge import (
+    FitStateError,
+    fit_stats_merge,
+    load_fit_state,
+    save_fit_state,
+)
+from keystone_tpu.learn.swap import ModelSwapper, SwapError
+
+__all__ = [
+    "FitStateError",
+    "ModelSwapper",
+    "SwapError",
+    "fit_stats_merge",
+    "load_fit_state",
+    "save_fit_state",
+]

@@ -1,0 +1,283 @@
+"""The fit protocol's missing third verb: ``merge``.
+
+``fit_stats_init/update/finalize`` (PR 10) stream one corpus through
+one process. Online learning needs two more degrees of freedom:
+
+- **time** — fold today's chunks into the state accumulated yesterday,
+  which requires the state itself to persist
+  (:func:`save_fit_state` / :func:`load_fit_state`, digest-checked so
+  a torn or tampered state file refuses loudly), and
+- **space** — combine states accumulated by different hosts, which
+  requires a commutative/associative pairwise :func:`fit_stats_merge`
+  (the multihost reduction IS the merge;
+  :func:`allmerge_fit_state` runs it over the coordination-service KV
+  channel the same way ``parallel/multihost.py`` gathers metrics).
+
+Merge semantics per state type:
+
+- :class:`~keystone_tpu.ops.linear.NormalEqState` keeps its Gram
+  CENTERED about a running mean, so the merge is Chan's pairwise
+  update: sums add, and a rank-1 mean-difference correction
+  ``(n·m/(n+m)) · δδᵀ`` re-centers the combined Gram. Commutative by
+  symmetry, associative in exact arithmetic (f32 drift stays inside
+  the fused-fit tolerance — the property tests pin 1e-6 on the
+  finalized mapper).
+- :class:`~keystone_tpu.ops.weighted_linear.WeightedEqState` holds raw
+  (uncentered) per-class sums — the merge is plain leaf-wise addition.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import itertools
+import pickle
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from keystone_tpu.core.logging import get_logger
+from keystone_tpu.core.serialization import _to_host, atomic_write
+
+logger = get_logger("keystone_tpu.learn.merge")
+
+_MAGIC_STATE = b"KSTS1\n"
+
+
+class FitStateError(ValueError):
+    """A fit-state file is torn, tampered, or structurally wrong —
+    digest mismatch, bad magic, or a state whose shapes disagree with
+    the estimator that is supposed to finalize it. Loud by design:
+    statistics silently merged onto a corrupt base would poison every
+    model refit from then on. Subclasses ValueError like
+    ``PipelineSpecError`` so generic callers keep working."""
+
+
+# ------------------------------------------------------------------- merge
+
+
+@jax.jit
+def _merge_normal_eq(a, b):
+    """Chan pairwise merge of two centered normal-equation states. An
+    empty side (n = 0) contributes nothing: the rank-1 weight n·m/(n+m)
+    and the mean step m/(n+m) both vanish, exactly as in the per-chunk
+    update."""
+    from keystone_tpu.ops.linear import NormalEqState
+
+    n_new = jnp.maximum(a.n + b.n, 1.0)
+    w = a.n * b.n / n_new
+    da = b.mean_a - a.mean_a
+    db = b.mean_b - a.mean_b
+    return NormalEqState(
+        ata=a.ata + b.ata + w * jnp.outer(da, da),
+        atb=a.atb + b.atb + w * jnp.outer(da, db),
+        mean_a=a.mean_a + (b.n / n_new) * da,
+        mean_b=a.mean_b + (b.n / n_new) * db,
+        n=a.n + b.n,
+    )
+
+
+@jax.jit
+def _merge_additive(a, b):
+    return jax.tree_util.tree_map(jnp.add, a, b)
+
+
+def fit_stats_merge(a: Any, b: Any) -> Any:
+    """Merge two accumulated fit states of the same type and shape —
+    commutative and associative, so a corpus split k ways folds to the
+    same statistics in any order (the property the multihost reduction
+    and the refit daemon's resume path both lean on).
+
+    Raises :class:`FitStateError` on type or shape disagreement: a
+    cross-host merge of states from different pipelines must fail at
+    the merge, not at a finalize three steps later.
+    """
+    from keystone_tpu.ops.linear import NormalEqState
+    from keystone_tpu.ops.weighted_linear import WeightedEqState
+
+    if type(a) is not type(b):
+        raise FitStateError(
+            f"cannot merge fit states of different types: "
+            f"{type(a).__name__} vs {type(b).__name__}"
+        )
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    shapes_a = [tuple(getattr(x, "shape", ())) for x in la]
+    shapes_b = [tuple(getattr(x, "shape", ())) for x in lb]
+    if shapes_a != shapes_b:
+        raise FitStateError(
+            f"cannot merge fit states of different shapes: "
+            f"{shapes_a} vs {shapes_b} (different pipelines?)"
+        )
+    if isinstance(a, NormalEqState):
+        return _merge_normal_eq(a, b)
+    if isinstance(a, WeightedEqState):
+        # every field is a raw masked sum — addition IS the merge
+        return _merge_additive(a, b)
+    raise FitStateError(
+        f"no merge rule for fit-state type {type(a).__name__}"
+    )
+
+
+# ------------------------------------------------------------ persistence
+
+
+@dataclasses.dataclass
+class FitState:
+    """One loaded fit-state artifact: the accumulated statistics plus
+    everything a refit needs to keep folding and re-finalizing —
+    the estimator (its ``fit_stats_*`` protocol and hyperparameters),
+    the featurize prefix the fused segment re-applies to NEW chunks
+    only, the feature-block ``widths`` finalize pins block edges with,
+    and free-form ``meta`` (the refit daemon keeps its persisted
+    offsets and version counter here)."""
+
+    state: Any
+    est: Any = None
+    prefix: tuple = ()
+    widths: tuple | None = None
+    meta: dict = dataclasses.field(default_factory=dict)
+
+
+def save_fit_state(
+    state: Any,
+    path: str,
+    *,
+    est: Any = None,
+    prefix: Any = (),
+    widths: Any = None,
+    **meta: Any,
+) -> str:
+    """Persist accumulated fit statistics (plus the estimator/prefix
+    needed to resume folding) to ``path`` — atomically, with a sha256
+    digest over the payload so :func:`load_fit_state` can refuse a torn
+    or corrupted artifact loudly. Returns the hex digest."""
+    if prefix is None:
+        prefix = ()
+    payload = {
+        "version": 1,
+        "state": _to_host(state),
+        "est": _to_host(est),
+        "prefix": tuple(_to_host(p) for p in prefix),
+        "widths": tuple(widths) if widths else None,
+        "meta": dict(meta),
+    }
+    blob = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+    digest = hashlib.sha256(blob).hexdigest()
+    with atomic_write(path) as f:
+        f.write(_MAGIC_STATE)
+        f.write(digest.encode() + b"\n")
+        f.write(blob)
+    return digest
+
+
+def load_fit_state(path: str) -> FitState:
+    """Load a :func:`save_fit_state` artifact, verifying the stored
+    digest over the payload bytes. Raises :class:`FitStateError` on bad
+    magic or digest mismatch (the ``refit.state_digest`` fault site
+    drills the mismatch path deterministically)."""
+    from keystone_tpu.resilience import faults as _faults
+
+    with open(path, "rb") as f:
+        magic = f.read(len(_MAGIC_STATE))
+        if magic != _MAGIC_STATE:
+            raise FitStateError(
+                f"{path} is not a keystone_tpu fit-state file"
+            )
+        digest = f.readline().strip().decode()
+        blob = f.read()
+    actual = hashlib.sha256(blob).hexdigest()
+    if actual != digest or _faults.fire("refit.state_digest", path):
+        raise FitStateError(
+            f"{path}: fit-state digest mismatch (stored {digest[:12]}…, "
+            f"computed {actual[:12]}…) — torn write or corruption; "
+            "refusing to fold new data onto a corrupt base"
+        )
+    payload = pickle.loads(blob)
+    return FitState(
+        state=payload["state"],
+        est=payload.get("est"),
+        prefix=tuple(payload.get("prefix") or ()),
+        widths=payload.get("widths"),
+        meta=dict(payload.get("meta") or {}),
+    )
+
+
+# ------------------------------------------------------- cross-host merge
+
+# per-process merge sequence: every host calls allmerge in the same
+# SPMD program order, so the counter yields matching KV keys/barrier
+# ids without extra coordination (the rollup_metrics idiom)
+_merge_seq = itertools.count()
+
+
+def allmerge_fit_state(state: Any, timeout_s: float = 60.0) -> Any:
+    """Merge this host's accumulated fit state with every peer's over
+    the coordination-service KV channel; ALL hosts must call it (it
+    synchronizes at a barrier) and every host returns the identical
+    merged state (states merged in process-id order — deterministic,
+    and associativity makes the order immaterial to 1e-6).
+
+    Single-process runs and transport failures degrade to the local
+    state with a warning — a lost merge loses freshness, never the run.
+    """
+    try:
+        nprocs = jax.process_count()
+        pid = jax.process_index()
+    except Exception:  # noqa: BLE001 — backend init failure
+        nprocs, pid = 1, 0
+    if nprocs == 1:
+        return state
+    from keystone_tpu.parallel.multihost import _coordination_client
+
+    client = _coordination_client()
+    if client is None:
+        logger.warning(
+            "fit-state merge: no coordination-service client; keeping "
+            "this host's state only"
+        )
+        return state
+    seq = next(_merge_seq)
+    try:
+        import base64
+
+        blob = pickle.dumps(
+            _to_host(state), protocol=pickle.HIGHEST_PROTOCOL
+        )
+        client.key_value_set(
+            f"keystone/fitstate/{seq}/{pid}",
+            base64.b64encode(blob).decode(),
+        )
+        client.wait_at_barrier(
+            f"keystone_fitstate_merge_{seq}", int(timeout_s * 1000)
+        )
+        merged = None
+        for i in range(nprocs):
+            peer_blob = base64.b64decode(
+                client.blocking_key_value_get(
+                    f"keystone/fitstate/{seq}/{i}", int(timeout_s * 1000)
+                )
+            )
+            peer = pickle.loads(peer_blob)
+            merged = peer if merged is None else fit_stats_merge(merged, peer)
+        # second barrier BEFORE the delete: every host must finish its
+        # reads first, or a fast host 0 would reclaim keys a slow peer
+        # is still fetching and that peer would silently degrade to a
+        # different (local-only) state than everyone else
+        client.wait_at_barrier(
+            f"keystone_fitstate_merge_done_{seq}", int(timeout_s * 1000)
+        )
+        if pid == 0:
+            try:
+                client.key_value_delete(f"keystone/fitstate/{seq}/")
+            except Exception:  # noqa: BLE001 — older jaxlib, best-effort
+                pass
+        return merged
+    except Exception as e:  # noqa: BLE001 — degraded, never fatal
+        logger.warning(
+            "fit-state merge over the coordination service failed (%r); "
+            "keeping this host's state only",
+            e,
+        )
+        return state

@@ -1,0 +1,212 @@
+"""Atomic hot-swap of a running server's model, under traffic.
+
+The refit daemon publishes a new fitted pipeline; the server must start
+answering with it without dropping a single in-flight request and
+without trusting it blindly. The swap protocol:
+
+1. **Load through the spec check** —
+   :func:`keystone_tpu.core.serialization.load_fitted` refuses a
+   checkpoint whose structure drifted from the code
+   (:class:`~keystone_tpu.core.serialization.PipelineSpecError`).
+2. **Compile before commit** — the candidate is AOT-exported over the
+   SAME batch buckets as the incumbent
+   (:class:`~keystone_tpu.serve.export.ExportedApply`), warm-started
+   from the persistent compile cache, and probed with one real row.
+   Until this succeeds the incumbent serves every request.
+3. **Commit atomically** — :meth:`ServeApp.swap_exported
+   <keystone_tpu.serve.server.ServeApp>` replaces the micro-batcher
+   under the server's model lock (submits and swaps serialize on it,
+   so no request can reach a closing batcher), then drains the old
+   batcher: queued requests finish on the model they were admitted
+   under. Zero dropped requests, zero 5xx — the threaded-burst test
+   pins it.
+4. **Fail loudly, keep the last good version** — any failure (spec
+   drift, compile error, the ``serve.swap_fail`` drill) leaves the
+   incumbent serving, bumps ``serve_model_swap_failed``, and emits a
+   ``model_swap`` event with ``action="rollback"`` naming both
+   versions.
+
+Every committed swap emits ``model_swap`` (``action="swap"``) with the
+old/new version ids — ``/healthz``, ``observe top``, and the run
+report all show the served version and swap count.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from typing import Any
+
+import numpy as np
+
+from keystone_tpu.core.logging import get_logger
+from keystone_tpu.observe import events as _events
+from keystone_tpu.observe import metrics as _metrics
+from keystone_tpu.resilience import faults as _faults
+
+logger = get_logger("keystone_tpu.learn.swap")
+
+
+class SwapError(RuntimeError):
+    """A model hot-swap failed before commit; the prior version is
+    still serving (the rollback already happened by construction —
+    nothing was committed)."""
+
+
+def version_of(path: str, meta: dict | None = None) -> str:
+    """The version id a checkpoint serves under: its ``save_fitted``
+    ``version`` meta when present, else the file's basename."""
+    if meta and meta.get("version") is not None:
+        return str(meta["version"])
+    return os.path.basename(path)
+
+
+class ModelSwapper:
+    """Orchestrates hot-swaps for one :class:`~keystone_tpu.serve.
+    server.ServeApp`: load → spec-check → AOT-export → probe → commit,
+    with rollback-by-not-committing on any failure.
+
+    ``source_path`` is the default reload source (the checkpoint the
+    server originally loaded, or the refit daemon's ``current.kst``
+    pointer) — ``POST /admin/reload`` with no body path and SIGHUP
+    both reload from it.
+    """
+
+    def __init__(self, app: Any, *, source_path: str | None = None):
+        self.app = app
+        self.source_path = source_path
+        self._swap_idx = itertools.count()
+        self._lock = threading.Lock()  # one swap at a time
+
+    # ------------------------------------------------------------ swaps
+
+    def swap_to_path(self, path: str | None = None) -> dict:
+        """Load the fitted pipeline at ``path`` (default: the source
+        path) and hot-swap it in. Returns
+        ``{old_version, new_version, swaps, wall_s}`` on success;
+        raises :class:`SwapError` (prior version still serving) on any
+        failure."""
+        path = path or self.source_path
+        if not path:
+            raise SwapError(
+                "no model path to reload from (server was not started "
+                "from a checkpoint; pass an explicit path)"
+            )
+        with self._lock:
+            idx = next(self._swap_idx)
+            old_version = getattr(self.app, "model_version", None)
+            t0 = time.perf_counter()
+            try:
+                from keystone_tpu.core.serialization import load_fitted
+
+                pipe, meta = load_fitted(path, with_meta=True)
+                new_version = version_of(path, meta)
+                exported = self._export(pipe, meta)
+                if _faults.fire("serve.swap_fail", idx):
+                    raise SwapError(
+                        f"injected swap failure (serve.swap_fail, swap "
+                        f"{idx})"
+                    )
+                # one real row through the candidate before commit: a
+                # pipeline that compiles but can't answer must roll back
+                probe = np.zeros(
+                    (1, *exported.row_shape), exported.dtype
+                )
+                np.asarray(exported(probe))
+            except Exception as e:  # noqa: BLE001 — every failure rolls back
+                self._observe(
+                    "rollback",
+                    old_version=old_version,
+                    new_version=version_of(path),
+                    path=path,
+                    error=f"{type(e).__name__}: {str(e)[:200]}",
+                )
+                logger.warning(
+                    "model swap to %s failed (%r); still serving %r",
+                    path,
+                    e,
+                    old_version,
+                )
+                if isinstance(e, SwapError):
+                    raise
+                raise SwapError(
+                    f"swap to {path} failed; still serving "
+                    f"{old_version!r} ({type(e).__name__}: {e})"
+                ) from e
+            return self._commit(
+                exported, new_version, path=path, t0=t0
+            )
+
+    def promote(self, exported: Any, version: str) -> dict:
+        """Commit an already-built-and-probed candidate (the shadow
+        runner's promotion path — the candidate has been scoring live
+        traffic, so load/compile/probe are already paid)."""
+        with self._lock:
+            return self._commit(exported, version, path=None,
+                                t0=time.perf_counter())
+
+    # ---------------------------------------------------------- helpers
+
+    def _export(self, pipe: Any, meta: dict) -> Any:
+        """AOT-export the candidate over the incumbent's buckets and
+        row shape (warm compile cache makes this seconds); the row
+        shape is the serving contract — a candidate that needs
+        different rows is spec drift at the traffic level and fails
+        here, before commit."""
+        from keystone_tpu.serve.export import ExportedApply
+
+        incumbent = self.app.exported
+        if incumbent is None:
+            raise SwapError("server has no exported pipeline to swap")
+        sample = meta.get("sample")
+        if sample is None:
+            sample = np.zeros(
+                (1, *incumbent.row_shape), incumbent.dtype
+            )
+        sample = np.asarray(sample)
+        if tuple(sample.shape[1:]) != tuple(incumbent.row_shape):
+            raise SwapError(
+                f"candidate row shape {tuple(sample.shape[1:])} != "
+                f"served {tuple(incumbent.row_shape)}"
+            )
+        return ExportedApply(pipe, sample, buckets=incumbent.buckets)
+
+    def _commit(
+        self, exported: Any, version: str, *, path: str | None, t0: float
+    ) -> dict:
+        old_version = getattr(self.app, "model_version", None)
+        self.app.swap_exported(exported, version=version)
+        wall = time.perf_counter() - t0
+        _metrics.get_registry().counter("serve_model_swaps").inc()
+        self._observe(
+            "swap",
+            old_version=old_version,
+            new_version=version,
+            path=path,
+            swaps=self.app.swap_count,
+            wall_s=round(wall, 3),
+        )
+        logger.info(
+            "model swapped: %r -> %r in %.2fs (swap #%d)",
+            old_version,
+            version,
+            wall,
+            self.app.swap_count,
+        )
+        return {
+            "old_version": old_version,
+            "new_version": version,
+            "swaps": self.app.swap_count,
+            "wall_s": round(wall, 3),
+        }
+
+    def _observe(self, action: str, **fields: Any) -> None:
+        if action == "rollback":
+            _metrics.get_registry().counter(
+                "serve_model_swap_failed"
+            ).inc()
+        log = _events.active()
+        if log is not None:
+            log.emit("model_swap", action=action, **fields)

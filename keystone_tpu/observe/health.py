@@ -25,6 +25,11 @@ Alert kinds (the ``action`` field):
 ``serve.deadline_miss``     dispatch-time deadline-miss rate over the
                             rolling window breached
 ``serve.shed_rate``         admission-shed rate breached
+``serve.feature_drift``     incoming request rows drifted from the fit
+                            state's accumulated feature means (the
+                            shadow runner feeds this — it gates online-
+                            learning promotion, :mod:`keystone_tpu.
+                            learn.shadow`)
 ==========================  ============================================
 
 Determinism: verdicts are pure functions of the fed values plus an
@@ -75,6 +80,9 @@ class HealthConfig:
     cooldown_steps: int = 32  # min steps between repeats of one kind
     cooldown_s: float = 30.0  # request-side repeat suppression
     slow_request_s: float | None = None  # None → KEYSTONE_SERVE_SLOW_MS
+    # mean per-feature |x̄ − μ|/σ of an incoming batch vs the fit
+    # state's accumulated statistics before serve.feature_drift fires
+    feature_drift_z: float = 6.0
 
     @classmethod
     def from_env(cls) -> "HealthConfig":
@@ -316,6 +324,28 @@ class HealthMonitor:
                 )
         for kind, detail in fires:
             self._fire(kind, **detail)
+
+    def note_feature_drift(self, z: float, *, rid: Any = None) -> None:
+        """One shadow-scored request batch's feature-drift score: the
+        mean per-feature ``|x̄ − μ|/σ`` of the incoming rows against the
+        fit state's accumulated means/variances
+        (:func:`keystone_tpu.learn.shadow.input_feature_stats`). Fires
+        ``serve.feature_drift`` above the configured z — the signal
+        that incoming traffic left the distribution the statistics were
+        accumulated on, which gates online-learning promotion."""
+        c = self.config
+        fire = None
+        with self._lock:
+            if z > c.feature_drift_z and self._time_cooldown_ok(
+                "serve.feature_drift"
+            ):
+                fire = {
+                    "z": round(float(z), 4),
+                    "threshold": c.feature_drift_z,
+                    "rid": rid,
+                }
+        if fire is not None:
+            self._fire("serve.feature_drift", **fire)
 
     def note_dispatch(self, *, requests: int, misses: int) -> None:
         """One micro-batch dispatch: how many of its requests had

@@ -47,6 +47,8 @@ def summarize(events: list[dict]) -> dict[str, Any]:
     optimizes: list[dict] = []
     clusters: list[dict] = []
     serves: list[dict] = []
+    swaps: list[dict] = []
+    refits: list[dict] = []
     alerts: list[dict] = []
     device_memory: dict | None = None
     trace_windows: list[dict] = []
@@ -80,6 +82,10 @@ def summarize(events: list[dict]) -> dict[str, Any]:
             clusters.append(ev)
         elif kind == "serve":
             serves.append(ev)
+        elif kind == "model_swap":
+            swaps.append(ev)
+        elif kind == "refit":
+            refits.append(ev)
         elif kind == "alert":
             alerts.append(ev)
         elif kind == "device_memory":
@@ -98,6 +104,8 @@ def summarize(events: list[dict]) -> dict[str, Any]:
         "optimizes": optimizes,
         "clusters": clusters,
         "serves": serves,
+        "model_swaps": swaps,
+        "refits": refits,
         "alerts": alerts,
         "device_memory": device_memory,
         "trace_windows": trace_windows,
@@ -242,6 +250,21 @@ def render(run_dir: str) -> str:
             )
             lines.append(f"  {ev.get('action', '?')}: {fields}")
         lines.append("")
+    for key, title in (
+        ("model_swaps", "model swaps (online-learning lifecycle):"),
+        ("refits", "refit daemon (online-learning folds):"),
+    ):
+        if summary.get(key):
+            lines.append(title)
+            for ev in summary[key]:
+                fields = ", ".join(
+                    f"{k}={v}"
+                    for k, v in ev.items()
+                    if k not in ("event", "ts", "run", "phase", "action")
+                    and v is not None
+                )
+                lines.append(f"  {ev.get('action', '?')}: {fields}")
+            lines.append("")
     lines.extend(_alert_section(run_dir, summary))
     lines.extend(_goodput_section(run_dir))
     lines.extend(_telemetry_sections(run_dir, summary))

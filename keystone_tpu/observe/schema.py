@@ -40,7 +40,13 @@ EVENT_KINDS: dict[str, str] = {
     "metrics_rollup": "multihost metrics merge completed "
     "(parallel/multihost.py)",
     "alert": "an anomaly-monitor verdict: step-time drift, loss spike, "
-    "HBM growth, deadline miss / shed rate (observe/health.py)",
+    "HBM growth, deadline miss / shed rate, feature drift "
+    "(observe/health.py)",
+    "model_swap": "online-learning model lifecycle: hot-swap with "
+    "old/new version ids, rollback of a failed candidate, shadow "
+    "start/stop (learn/swap.py, serve/server.py)",
+    "refit": "a refit-daemon decision: chunk folded/skipped, versioned "
+    "model published, reload notify (learn/refit.py)",
 }
 
 _warned: set[str] = set()

@@ -243,6 +243,17 @@ def summarize(steps: list[dict], events: list[dict]) -> dict[str, Any]:
                 sv["status"] = "serving"
             elif action == "stop":
                 sv["status"] = "stopped"
+        elif kind == "model_swap":
+            # the online-learning lifecycle: committed swaps advance the
+            # served version; rollbacks count separately (the panel must
+            # show a failed candidate never took over)
+            sv = out["serve"]
+            action = str(ev.get("action", "?"))
+            if action == "swap":
+                sv["version"] = ev.get("new_version")
+                sv["swaps"] = sv.get("swaps", 0) + 1
+            elif action == "rollback":
+                sv["rollbacks"] = sv.get("rollbacks", 0) + 1
         elif kind == "optimize":
             out["plan_decisions"] += len(ev.get("decisions") or []) or 1
         elif kind == "trace_window":
@@ -343,10 +354,21 @@ def render(state: dict[str, Any], run_dir: str) -> str:
             head += f" {sv['model']}"
         if sv.get("port"):
             head += f" @ :{sv['port']}"
+        if sv.get("version"):
+            head += f"  model={sv['version']}"
         if sv.get("status"):
             head += f"  [{sv['status']}]"
         if isinstance(sv.get("cold_start_s"), (int, float)):
             head += f"  cold start {sv['cold_start_s']:.2f}s"
+        if sv.get("swaps") or sv.get("rollbacks"):
+            head += (
+                f"  swaps={sv.get('swaps', 0)}"
+                + (
+                    f" rollbacks={sv['rollbacks']}"
+                    if sv.get("rollbacks")
+                    else ""
+                )
+            )
         lines.append(head)
         parts = []
         if sv.get("batches"):

@@ -499,13 +499,22 @@ def _fused_fit_update(prefix, est, state, chunk, labels, valid, gram_fn):
     )
 
 
-def fit_stream(plan: Plan, data: Any, labels: Any, *, n_valid=None):
+def fit_stream(
+    plan: Plan, data: Any, labels: Any, *, n_valid=None, init_state=None
+):
     """Execute a fused streaming-fit plan: drive staged (data, labels)
     chunks through the sink's ``featurize → fit_stats_update`` step on
     the shared staging engine (:func:`keystone_tpu.core.staging.
     fold_staged` — chunk k+1's host→device transfer overlaps chunk k's
     accumulate), returning the accumulated state for the caller's
     ``fit_stats_finalize``.
+
+    ``init_state`` seeds the fold with previously accumulated
+    statistics instead of a zero state — the online-learning verb
+    (:mod:`keystone_tpu.learn`): a refit folds ONLY the new chunks, so
+    rows already inside the state are never re-featurized (the
+    ``plan_fused_fit_rows`` counter advances by exactly the new rows —
+    the incremental-vs-full parity tests pin this).
 
     Pad rows — ragged tail or shard rounding — are masked out of the
     statistics via each chunk's ``n_valid``. Emits one ``source=
@@ -577,7 +586,9 @@ def fit_stream(plan: Plan, data: Any, labels: Any, *, n_valid=None):
         state = fold_staged(
             chunks(),
             update,
-            est.fit_stats_init(sink.d, sink.k),
+            init_state
+            if init_state is not None
+            else est.fit_stats_init(sink.d, sink.k),
             sharding=sharding,
             stage_depth=plan.stage_depth,
             inflight=max(plan.prefetch, 0),
@@ -585,6 +596,10 @@ def fit_stream(plan: Plan, data: Any, labels: Any, *, n_valid=None):
     wall = time.perf_counter() - t0
     reg.counter("plan_fused_fits").inc()
     reg.counter("plan_fused_fit_chunks").inc(n_chunks)
+    # every row that went THROUGH the fused featurize+accumulate step —
+    # the never-refeaturize-old-data pin: an incremental refit advances
+    # this by only the new rows
+    reg.counter("plan_fused_fit_rows").inc(n_ok)
     if steplog is not None:
         flops = plan.prefix[-1].cost.flops * n
         steplog.step(

@@ -76,6 +76,15 @@ SITES: dict[str, str] = {
     "serve.slow_request": "inject KEYSTONE_SERVE_SLOW_MS of extra "
     "latency into the keyed request before dispatch — the tail-latency "
     "drill (serve/server.py; key = request id)",
+    "refit.corrupt_chunk": "fail reading the keyed labeled chunk in the "
+    "refit daemon — the chunk is skipped with a counter and the stream "
+    "continues (learn/refit.py; key = chunk file name)",
+    "refit.state_digest": "report a fit-state digest mismatch on load — "
+    "the refit daemon must refuse the corrupt base loudly "
+    "(learn/merge.py; key = state path)",
+    "serve.swap_fail": "fail a model hot-swap after the candidate "
+    "compiled but before commit — the server must keep serving the "
+    "prior version and say so (learn/swap.py; key = swap index)",
 }
 
 

@@ -16,6 +16,15 @@ Endpoints::
     POST /generate {"prompt": [...], "max_new"}  -> {"tokens": [...]}
     GET  /healthz                                -> status + latency summary
     GET  /metrics                                -> metrics registry snapshot
+    POST /admin/reload  {"path"?}                -> hot-swap the served model
+    POST /admin/shadow  {"path", ...}            -> start shadow-scoring a candidate
+    GET  /admin/shadow                           -> shadow verdict so far
+    POST /admin/promote {"force"?}               -> gated promote (409 = gate failed)
+    POST /admin/shadow/stop                      -> discard the candidate
+
+(the /admin/* surface is the online-learning loop — see
+``keystone_tpu/learn/``; SIGHUP hot-reloads from the original
+checkpoint path the same way /admin/reload with no body does)
 
 Wiring (the point of serving *this* framework):
 
@@ -90,6 +99,7 @@ class ServeApp:
         decode_loop=None,
         deadline_ms: float | None = None,
         watchdog_timeout_s: float = 60.0,
+        model_version: str | None = None,
     ):
         if exported is None and decode_loop is None:
             raise ValueError("need an exported pipeline and/or a decode loop")
@@ -99,6 +109,19 @@ class ServeApp:
         self._inflight = 0
         self._lock = threading.Lock()
         self._stop = threading.Event()
+        # online-learning surface: the served model's version identity,
+        # how many hot-swaps this process has taken, the swapper that
+        # performs them (attached by build_app for reloadable models),
+        # and an optional shadow scorer. _model_lock serializes batcher
+        # SUBMITS against batcher REPLACEMENT — the invariant behind
+        # zero dropped requests across a swap (a request can never
+        # reach a batcher that is already closing).
+        self.model_version = model_version
+        self.swap_count = 0
+        self._model_lock = threading.Lock()
+        self._deadline_ms = deadline_ms
+        self.swapper = None
+        self.shadow = None
         self.batcher = None
         if exported is not None:
             from keystone_tpu.serve.queue import MicroBatcher
@@ -192,7 +215,11 @@ class ServeApp:
             with self._bracket(), _spans.span(
                 "serve.request", rid=rid, kind="predict"
             ):
-                fut = self.batcher.submit(rows, rid=rid)
+                # submit under the model lock: a hot-swap replaces the
+                # batcher under the same lock, so this request lands on
+                # a batcher that will be drained, never one mid-close
+                with self._model_lock:
+                    fut = self.batcher.submit(rows, rid=rid)
                 out = np.asarray(fut.result(timeout=_request_timeout_s()))
         finally:
             # finally, not on success only: a timed-out request is by
@@ -200,6 +227,11 @@ class ServeApp:
             _health.get_monitor().note_request(
                 time.perf_counter() - t0, rid=rid
             )
+        shadow = self.shadow
+        if shadow is not None:
+            # after the primary result resolved: the shadow scorer only
+            # copies references into its bounded queue (never blocks)
+            shadow.observe(rows, out, rid=rid)
         return out
 
     def generate(self, prompt, max_new: int | None = None) -> np.ndarray:
@@ -225,6 +257,32 @@ class ServeApp:
             )
         return out
 
+    # ------------------------------------------------------------- swap
+
+    def swap_exported(self, exported, version: str | None = None) -> None:
+        """Atomically replace the served pipeline: a NEW micro-batcher
+        on the candidate's executables goes live under the model lock
+        (no submit can interleave), then the OLD batcher drains — every
+        request already queued finishes on the model it was admitted
+        under. Zero dropped requests by construction; the caller
+        (:class:`keystone_tpu.learn.swap.ModelSwapper`) owns the
+        load/spec-check/probe protocol in front of this."""
+        from keystone_tpu.serve.queue import MicroBatcher
+
+        new_batcher = MicroBatcher(
+            exported,
+            buckets=exported.buckets,
+            deadline_ms=self._deadline_ms,
+        )
+        with self._model_lock:
+            old_batcher = self.batcher
+            self.batcher = new_batcher
+            self.exported = exported
+            self.model_version = version
+            self.swap_count += 1
+        if old_batcher is not None:
+            old_batcher.close(drain=True)
+
     def health(self) -> dict:
         reg = _metrics.get_registry()
         snap = reg.snapshot()
@@ -240,15 +298,121 @@ class ServeApp:
             "batch_fill": snap.get("serve_batch_fill", 0.0),
             "slots_active": snap.get("serve_slots_active", 0.0),
         }
+        if self.exported is not None:
+            # the online-learning surface: which model version answers
+            # /predict right now, and how many hot-swaps got it there
+            out["model_version"] = self.model_version
+            out["model_swaps"] = self.swap_count
+        # local capture: a concurrent promote/stop can null the attr
+        # between the check and the call (ThreadingHTTPServer)
+        shadow = self.shadow
+        if shadow is not None:
+            out["shadow"] = shadow.verdict()
         for name, summ in (("queue", t), ("http", th)):
             if summ.get("count"):
                 out[f"{name}_p50_ms"] = round(summ.get("p50_s", 0.0) * 1e3, 3)
                 out[f"{name}_p95_ms"] = round(summ.get("p95_s", 0.0) * 1e3, 3)
         return out
 
+    # ----------------------------------------------------------- shadow
+
+    def start_shadow(
+        self, path: str, state_path: str | None = None, **kw
+    ) -> dict:
+        """Load a candidate checkpoint (spec-checked), AOT-export it
+        over the incumbent's buckets, and start scoring sampled
+        requests in shadow. ``kw`` forwards to
+        :class:`keystone_tpu.learn.shadow.ShadowRunner`
+        (sample_every, divergence_threshold, min_samples,
+        feature_stats). ``state_path`` names the refit daemon's fit
+        state: its accumulated means/variances arm the feature-drift
+        half of the promotion gate (when the state tracks input space
+        — a non-trivial featurize prefix can't, and the drift gate
+        degrades to divergence-only)."""
+        if self.swapper is None:
+            raise ValueError("no model swapper on this server")
+        from keystone_tpu.core.serialization import load_fitted
+        from keystone_tpu.learn.shadow import (
+            ShadowRunner,
+            input_feature_stats,
+        )
+        from keystone_tpu.learn.swap import version_of
+
+        if state_path and "feature_stats" not in kw:
+            from keystone_tpu.learn.merge import load_fit_state
+
+            kw["feature_stats"] = input_feature_stats(
+                load_fit_state(state_path)
+            )
+        pipe, meta = load_fitted(path, with_meta=True)
+        exported = self.swapper._export(pipe, meta)
+        version = version_of(path, meta)
+        old, self.shadow = self.shadow, ShadowRunner(
+            exported, version, **kw
+        )
+        if old is not None:
+            old.close()
+        self.swapper._observe(
+            "shadow_start", candidate_version=version, path=path
+        )
+        return {"candidate_version": version, "shadowing": True}
+
+    def promote_shadow(self, force: bool = False) -> dict:
+        """Apply the promotion gate to the running shadow candidate:
+        promoted candidates hot-swap in (the compile cost is already
+        paid — they have been scoring live traffic); a failed gate
+        DISCARDS the candidate and keeps the last-good primary serving
+        (auto-rollback by never committing), loudly."""
+        shadow = self.shadow
+        if shadow is None:
+            raise ValueError("no shadow candidate running")
+        shadow.drain()
+        verdict = shadow.verdict()
+        if not verdict["promote"] and not force:
+            self.shadow = None
+            shadow.close()
+            self.swapper._observe(
+                "rollback",
+                old_version=self.model_version,
+                new_version=shadow.version,
+                reason="shadow_gate",
+                **{
+                    k: verdict[k]
+                    for k in (
+                        "samples", "mean_divergence", "drift_alerts"
+                    )
+                },
+            )
+            logger.warning(
+                "shadow candidate %r rejected (divergence %.4f, %d "
+                "drift alert(s)); still serving %r",
+                shadow.version,
+                verdict["mean_divergence"],
+                verdict["drift_alerts"],
+                self.model_version,
+            )
+            return {"promoted": False, **verdict}
+        res = self.swapper.promote(shadow.exported, shadow.version)
+        self.shadow = None
+        shadow.close()
+        return {"promoted": True, **verdict, **res}
+
+    def stop_shadow(self) -> dict:
+        shadow, self.shadow = self.shadow, None
+        if shadow is None:
+            return {"shadowing": False}
+        verdict = shadow.verdict()
+        shadow.close()
+        self.swapper._observe(
+            "shadow_stop", candidate_version=shadow.version
+        )
+        return {"shadowing": False, **verdict}
+
     def shutdown(self) -> None:
         """Drain: no new work, finish queued work, stop the threads."""
         self._stop.set()
+        if self.shadow is not None:
+            self.shadow.close()
         if self.batcher is not None:
             self.batcher.close(drain=True)
         if self._decode_thread is not None:
@@ -283,6 +447,11 @@ def _handler_for(app: ServeApp):
         def do_GET(self):  # noqa: N802 — stdlib API
             if self.path == "/healthz":
                 return self._send(200, app.health())
+            if self.path == "/admin/shadow":
+                shadow = app.shadow  # local capture vs concurrent stop
+                if shadow is None:
+                    return self._send(404, {"shadowing": False})
+                return self._send(200, shadow.verdict())
             if self.path == "/metrics":
                 # Prometheus text exposition by default (what a scraper
                 # expects); the JSON snapshot stays available behind
@@ -301,7 +470,11 @@ def _handler_for(app: ServeApp):
                 404,
                 {
                     "error": f"unknown path {self.path}",
-                    "paths": ["/predict", "/generate", "/healthz", "/metrics"],
+                    "paths": [
+                        "/predict", "/generate", "/healthz", "/metrics",
+                        "/admin/reload", "/admin/shadow",
+                        "/admin/promote",
+                    ],
                 },
             )
 
@@ -312,6 +485,8 @@ def _handler_for(app: ServeApp):
                 body = json.loads(self.rfile.read(n) or b"{}")
             except ValueError:
                 return self._send(400, {"error": "invalid JSON body"})
+            if self.path.startswith("/admin/"):
+                return self._admin(body)
             try:
                 if self.path == "/predict":
                     rows = np.asarray(body.get("rows"), np.float32)
@@ -338,6 +513,63 @@ def _handler_for(app: ServeApp):
             _metrics.get_registry().timer("serve_http_seconds").observe(wall)
             payload["ms"] = round(wall * 1e3, 3)
             self._send(200, payload)
+
+        def _admin(self, body: dict) -> None:
+            """The online-learning control surface: reload (hot-swap),
+            shadow start, gated promote, shadow stop. Failures answer
+            structured JSON with the still-serving version — a failed
+            swap already rolled back by construction."""
+            from keystone_tpu.learn.swap import SwapError
+
+            try:
+                if self.path == "/admin/reload":
+                    if app.swapper is None:
+                        return self._send(
+                            409, {"error": "no model swapper on this server"}
+                        )
+                    return self._send(
+                        200, app.swapper.swap_to_path(body.get("path"))
+                    )
+                if self.path == "/admin/shadow":
+                    kw = {
+                        k: body[k]
+                        for k in (
+                            "state_path",
+                            "sample_every",
+                            "divergence_threshold",
+                            "min_samples",
+                        )
+                        if k in body
+                    }
+                    return self._send(
+                        200, app.start_shadow(body["path"], **kw)
+                    )
+                if self.path == "/admin/promote":
+                    res = app.promote_shadow(
+                        force=bool(body.get("force"))
+                    )
+                    return self._send(
+                        200 if res.get("promoted") else 409, res
+                    )
+                if self.path == "/admin/shadow/stop":
+                    return self._send(200, app.stop_shadow())
+                return self._send(
+                    404, {"error": f"unknown admin path {self.path}"}
+                )
+            except SwapError as e:
+                return self._send(
+                    500,
+                    {
+                        "error": str(e),
+                        "rolled_back": True,
+                        "version": app.model_version,
+                    },
+                )
+            except (KeyError, ValueError, TypeError) as e:
+                return self._send(400, {"error": repr(e)})
+            except Exception as e:  # noqa: BLE001 — must answer
+                logger.warning("admin request failed: %r", e)
+                return self._send(500, {"error": repr(e)})
 
     return Handler
 
@@ -459,10 +691,20 @@ def build_app(target: str, args: dict) -> ServeApp:
         buckets = tuple(
             sorted(int(b) for b in str(args["buckets"]).split(",") if b)
         )
+    from keystone_tpu.learn.swap import ModelSwapper, version_of
+
     if target in ("mnist", "mnist-random-fft"):
         pipe, sample = _fit_mnist_demo(int(args.get("synthetic", 2048)))
         exported = export_pipeline(pipe, sample, buckets=buckets)
-        return ServeApp(exported=exported, deadline_ms=deadline)
+        app = ServeApp(
+            exported=exported,
+            deadline_ms=deadline,
+            model_version="mnist-demo",
+        )
+        # reloadable with an explicit path (POST /admin/reload
+        # {"path": ...}); no default source — the demo fit has no file
+        app.swapper = ModelSwapper(app)
+        return app
     if target == "lm":
         model = _build_lm(args)
         loop = export_lm(
@@ -486,7 +728,15 @@ def build_app(target: str, args: dict) -> ServeApp:
                 )
             sample = np.zeros((1, int(args["input_dim"])), np.float32)
         exported = export_pipeline(pipe, np.asarray(sample), buckets=buckets)
-        return ServeApp(exported=exported, deadline_ms=deadline)
+        app = ServeApp(
+            exported=exported,
+            deadline_ms=deadline,
+            model_version=version_of(target, meta),
+        )
+        # the reload source: POST /admin/reload with no path and SIGHUP
+        # both re-read this file — the refit daemon republishes it
+        app.swapper = ModelSwapper(app, source_path=target)
+        return app
     raise SystemExit(
         f"unknown model {target!r}: not a checkpoint path, 'mnist', or 'lm'"
     )
@@ -526,6 +776,28 @@ def main(argv: list[str] | None = None) -> None:
 
     signal.signal(signal.SIGTERM, _term)
     signal.signal(signal.SIGINT, _term)
+
+    def _hup(signum, frame):
+        # hot-reload from the original checkpoint path (the refit
+        # daemon atomically republishes it) — off the signal frame, and
+        # a failed swap keeps the prior version serving by construction
+        if app.swapper is None or not app.swapper.source_path:
+            logger.warning("SIGHUP: no reloadable model path; ignored")
+            return
+
+        def reload():
+            from keystone_tpu.learn.swap import SwapError
+
+            try:
+                res = app.swapper.swap_to_path()
+                logger.info("SIGHUP reload: %s", res)
+            except SwapError as e:
+                logger.warning("SIGHUP reload failed: %s", e)
+
+        threading.Thread(target=reload, daemon=True).start()
+
+    if hasattr(signal, "SIGHUP"):
+        signal.signal(signal.SIGHUP, _hup)
     print(
         f"serving {target!r} on http://{host}:{port} "
         f"(cold start {cold:.2f}s)",
