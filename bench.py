@@ -1208,6 +1208,143 @@ def bench_serve_latency(
     return out
 
 
+def bench_fleet_latency(
+    n_requests: int = 48,
+    replicas: int = 3,
+    fit_n: int = 96,
+    num_ffts: int = 2,
+    compare_single: bool = True,
+) -> dict:
+    """Serving-fleet record (serve/fleet.py): aggregate throughput +
+    request p50/p95 through the health-aware router over N real mnist
+    replica processes vs a single replica, and the same burst with one
+    replica SIGKILLed mid-run (`fleet.replica_kill` drill — the record
+    pins zero client errors and the failover count). Replicas run on
+    the CPU backend regardless of the bench host: N processes cannot
+    share one chip, and the fleet's routing/failover economics are
+    host-side anyway."""
+    import concurrent.futures
+    import tempfile
+    import time as _time
+
+    from keystone_tpu.observe import metrics as observe_metrics
+    from keystone_tpu.observe.telemetry import percentiles
+    from keystone_tpu.resilience import faults as _flt
+    from keystone_tpu.serve.fleet import Fleet
+
+    reg = observe_metrics.get_registry()
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "KEYSTONE_SERVE_DEADLINE_MS": "5",
+        "KEYSTONE_COMPILE_CACHE_DIR": os.environ.get(
+            "KEYSTONE_COMPILE_CACHE_DIR"
+        )
+        or tempfile.mkdtemp(prefix="fleet-bench-cache-"),
+    }
+    cmd = [
+        sys.executable, "-m", "keystone_tpu", "serve", "mnist",
+        "--port", "{port}", "--synthetic", str(fit_n),
+        "--num-ffts", str(num_ffts), "--buckets", "1,4,8",
+    ]
+    rng = np.random.default_rng(0)
+    reqs = [
+        rng.normal(size=(int(rng.integers(1, 4)), 784))
+        .astype(np.float32)
+        .tolist()
+        for _ in range(n_requests)
+    ]
+
+    def burst(fleet, kill_at=None):
+        if kill_at is not None:
+            _flt.configure(f"fleet.replica_kill:@{kill_at}:0")
+        lat: list[float] = []
+        errors = 0
+
+        def one(rows):
+            t0 = _time.perf_counter()
+            fleet.forward("/predict", {"rows": rows})
+            return _time.perf_counter() - t0
+
+        t0 = _time.perf_counter()
+        try:
+            with concurrent.futures.ThreadPoolExecutor(
+                max_workers=8
+            ) as pool:
+                for fut in [pool.submit(one, r) for r in reqs]:
+                    try:
+                        lat.append(fut.result(timeout=180.0))
+                    except Exception:  # noqa: BLE001 — tallied
+                        errors += 1
+        finally:
+            _flt.reset()
+        return lat, errors, _time.perf_counter() - t0
+
+    def run_tier(n, kill_drill=False):
+        fleet = Fleet(
+            cmd=cmd, n=n, env=env, poll_s=0.2, grace_s=15.0,
+            boot_timeout_s=300.0, deadline_ms=20000.0, max_inflight=64,
+        )
+        t_boot = _time.perf_counter()
+        try:
+            fleet.start(wait_up=n, timeout=300.0)
+            boot_s = _time.perf_counter() - t_boot
+            lat, errors, wall = burst(fleet)
+            p = percentiles(lat, (50, 95)) if lat else {50: 0.0, 95: 0.0}
+            rec = {
+                "boot_s": round(boot_s, 2),
+                "request_p50_ms": round(p[50] * 1e3, 2),
+                "request_p95_ms": round(p[95] * 1e3, 2),
+                "requests_per_s": round(len(lat) / wall, 1) if wall else 0.0,
+                "errors": errors,
+            }
+            if kill_drill:
+                # the same burst again, killing a replica mid-run: the
+                # router's rid counter has advanced, so key the drill
+                # relative to what it will hand out next
+                failover0 = reg.snapshot().get("fleet_failover", 0)
+                # key the drill a third of the way into the burst,
+                # relative to the next id the router will hand out
+                kill_at = fleet.next_rid + max(len(reqs) // 3, 1)
+                lat_k, errors_k, wall_k = burst(fleet, kill_at=kill_at)
+                pk = (
+                    percentiles(lat_k, (50, 95))
+                    if lat_k
+                    else {50: 0.0, 95: 0.0}
+                )
+                rec["kill_drill"] = {
+                    "errors": errors_k,
+                    "failover": int(
+                        reg.snapshot().get("fleet_failover", 0) - failover0
+                    ),
+                    "request_p50_ms": round(pk[50] * 1e3, 2),
+                    "request_p95_ms": round(pk[95] * 1e3, 2),
+                    "requests_per_s": (
+                        round(len(lat_k) / wall_k, 1) if wall_k else 0.0
+                    ),
+                }
+            return rec
+        finally:
+            fleet.shutdown(grace_s=10.0)
+
+    out: dict = {"replicas": replicas, "requests": n_requests}
+    tier = run_tier(replicas, kill_drill=True)
+    out.update(tier)
+    if compare_single:
+        single = run_tier(1)
+        out["single_replica"] = {
+            k: single[k]
+            for k in (
+                "request_p50_ms", "request_p95_ms", "requests_per_s",
+            )
+        }
+        if single["requests_per_s"]:
+            out["aggregate_vs_single"] = round(
+                out["requests_per_s"] / single["requests_per_s"], 2
+            )
+    return out
+
+
 def bench_sift() -> dict:
     """Dense-SIFT featurize, device (XLA) path, with the C++ host kernel
     (native/dsift.cpp, the VLFeat-shim parity fallback) as baseline."""
@@ -1533,6 +1670,16 @@ def main() -> None:
         result["serve_latency"] = bench_serve_latency()
     except Exception as e:  # noqa: BLE001 — same contract as above
         result["serve_latency"] = {
+            "error": f"{type(e).__name__}: {str(e)[:200]}"
+        }
+    # serving-fleet record (serve/fleet.py): aggregate throughput +
+    # latency for N replicas vs 1 through the health-aware router, and
+    # the replica-kill drill (zero errors + failover count) — replicas
+    # always run the CPU backend, so this runs everywhere
+    try:
+        result["fleet_latency"] = bench_fleet_latency()
+    except Exception as e:  # noqa: BLE001 — same contract as above
+        result["fleet_latency"] = {
             "error": f"{type(e).__name__}: {str(e)[:200]}"
         }
     # goodput breakdown (observe/spans.py): bucket shares + critical

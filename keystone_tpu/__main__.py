@@ -58,6 +58,7 @@ COMMANDS = {
     "plan": "keystone_tpu.plan.cli",
     "supervise": "keystone_tpu.resilience.supervisor",
     "serve": "keystone_tpu.serve.server",
+    "fleet": "keystone_tpu.serve.fleet",
     "refit": "keystone_tpu.learn.refit",
 }
 
@@ -106,6 +107,9 @@ def main(argv: list[str] | None = None) -> None:
             f" `supervise -- CMD` relaunches a multihost job on host loss —\n"
             f" see `supervise --help`; `serve <model> [--port N]` serves a\n"
             f" fitted pipeline or LM over HTTP/JSON — see `serve --help`;\n"
+            f" `fleet <model>` runs a health-aware router over N replica\n"
+            f" servers with failover and `fleet restart` rolling restarts —\n"
+            f" see `fleet --help`;\n"
             f" `refit <state> --watch DIR` folds live labeled chunks into\n"
             f" streaming-fit state and republishes versioned models — see\n"
             f" `refit --help`)"

@@ -47,6 +47,7 @@ def summarize(events: list[dict]) -> dict[str, Any]:
     optimizes: list[dict] = []
     clusters: list[dict] = []
     serves: list[dict] = []
+    fleets: list[dict] = []
     swaps: list[dict] = []
     refits: list[dict] = []
     alerts: list[dict] = []
@@ -82,6 +83,12 @@ def summarize(events: list[dict]) -> dict[str, Any]:
             clusters.append(ev)
         elif kind == "serve":
             serves.append(ev)
+        elif kind == "resilience" and str(ev.get("action", "")).startswith(
+            "fleet_"
+        ):
+            # fleet routing/failover/restart decisions get their own
+            # section (they ride the resilience schema on the wire)
+            fleets.append(ev)
         elif kind == "model_swap":
             swaps.append(ev)
         elif kind == "refit":
@@ -104,6 +111,7 @@ def summarize(events: list[dict]) -> dict[str, Any]:
         "optimizes": optimizes,
         "clusters": clusters,
         "serves": serves,
+        "fleet": fleets,
         "model_swaps": swaps,
         "refits": refits,
         "alerts": alerts,
@@ -247,6 +255,27 @@ def render(run_dir: str) -> str:
                 f"{k}={v}"
                 for k, v in ev.items()
                 if k not in ("event", "ts", "run", "phase", "action")
+            )
+            lines.append(f"  {ev.get('action', '?')}: {fields}")
+        lines.append("")
+    if summary.get("fleet"):
+        by_action: dict[str, int] = {}
+        for ev in summary["fleet"]:
+            action = str(ev.get("action", "?"))
+            by_action[action] = by_action.get(action, 0) + 1
+        lines.append(
+            "serving fleet (router / replica lifecycle): "
+            + "  ".join(
+                f"{k.removeprefix('fleet_')}={v}"
+                for k, v in sorted(by_action.items())
+            )
+        )
+        for ev in summary["fleet"][-8:]:
+            fields = ", ".join(
+                f"{k}={v}"
+                for k, v in ev.items()
+                if k not in ("event", "ts", "run", "phase", "action")
+                and v is not None
             )
             lines.append(f"  {ev.get('action', '?')}: {fields}")
         lines.append("")

@@ -28,7 +28,9 @@ EVENT_KINDS: dict[str, str] = {
     "core/fusion.py, core/staging.py)",
     "bench": "the bench.py result record routed through the run log",
     "resilience": "a survived resilience decision: fault, retry, guard, "
-    "preemption (resilience/emit.py)",
+    "preemption (resilience/emit.py); fleet routing/failover/breaker/"
+    "restart decisions ride the same kind with action=fleet_* "
+    "(serve/fleet.py)",
     "cluster": "a membership decision: heartbeat, verdict, re-mesh "
     "(resilience/cluster.py)",
     "serve": "serving lifecycle: start/stop, model, port "

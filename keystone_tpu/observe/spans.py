@@ -277,6 +277,33 @@ def read_spans(run_dir: str) -> list[dict]:
     return _events.read_jsonl_rotated(os.path.join(run_dir, SPANS_FILE))
 
 
+def read_spans_all(base_dir: str) -> list[dict]:
+    """EVERY run's span records under a base observe directory, merged
+    and sorted by emission time — the cross-process view. A fleet is
+    several processes (router + N replicas) each writing its own run
+    dir; one request's causal tree spans them (the router's
+    ``X-Keystone-Trace`` hop header carries the ids across), so the
+    trace renderer must read them together to show router queue →
+    replica queue → device compute as one tree."""
+    if os.path.isfile(os.path.join(base_dir, SPANS_FILE)):
+        dirs = [base_dir]
+    else:
+        dirs = [
+            os.path.join(base_dir, d)
+            for d in (
+                os.listdir(base_dir) if os.path.isdir(base_dir) else ()
+            )
+            if os.path.isfile(os.path.join(base_dir, d, SPANS_FILE))
+        ]
+    out: list[dict] = []
+    for d in sorted(dirs):
+        out.extend(
+            _events.read_jsonl_rotated(os.path.join(d, SPANS_FILE))
+        )
+    out.sort(key=lambda r: float(r.get("ts") or 0.0))
+    return out
+
+
 def build_trees(spans: list[dict]) -> dict[str, list[dict]]:
     """Group spans into per-trace trees: trace id → list of root nodes,
     each node ``{"rec": span, "children": [nodes...]}`` (children in
@@ -497,7 +524,15 @@ def main(argv: list[str] | None = None) -> None:
             "KEYSTONE_OBSERVE_DIR (the newest run under it is rendered)"
         )
     try:
-        spans = read_spans(argv[0])
+        if request is not None:
+            # a request id is a cross-process question: the fleet
+            # router and its replicas each wrote their own run dir
+            # under the base — merge them so the tree crosses the hop
+            spans = read_spans_all(argv[0])
+            if not spans:
+                spans = read_spans(argv[0])
+        else:
+            spans = read_spans(argv[0])
     except OSError as e:
         raise SystemExit(str(e)) from None
     print(render_traces(spans, request=request, limit=limit))

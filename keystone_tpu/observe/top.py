@@ -138,6 +138,7 @@ def summarize(steps: list[dict], events: list[dict]) -> dict[str, Any]:
         "plan_streams": 0,
         "trace_windows": 0,
         "serve": {},
+        "fleet": {},
         "last_ts": None,
     }
     # the stream mixes sources: train steps (source="train") carry the
@@ -225,6 +226,31 @@ def summarize(steps: list[dict], events: list[dict]) -> dict[str, Any]:
             out["status"] = ev.get("status") or "done"
         elif kind == "resilience":
             action = str(ev.get("action", "?"))
+            if action.startswith("fleet_"):
+                # fleet routing/failover/lifecycle decisions render in
+                # their own panel (per-replica state + counters), not
+                # the generic resilience counter line
+                fl = out["fleet"]
+                if action == "fleet_replica_state":
+                    fl.setdefault("replicas", {})[
+                        str(ev.get("replica"))
+                    ] = {
+                        "state": ev.get("state"),
+                        "port": ev.get("port"),
+                        "restarts": ev.get("restarts", 0),
+                    }
+                elif action == "fleet_stats":
+                    for key in ("routed", "shed", "failover", "hedges"):
+                        if ev.get(key) is not None:
+                            fl[key] = ev[key]
+                    for rid, state in (ev.get("replicas") or {}).items():
+                        fl.setdefault("replicas", {}).setdefault(
+                            str(rid), {}
+                        )["state"] = state
+                else:
+                    fl.setdefault("events", {})
+                    fl["events"][action] = fl["events"].get(action, 0) + 1
+                continue
             out["resilience"][action] = out["resilience"].get(action, 0) + 1
         elif kind == "cluster":
             action = str(ev.get("action", "?"))
@@ -384,6 +410,40 @@ def render(state: dict[str, Any], run_dir: str) -> str:
             )
         if parts:
             lines.append("  " + "  ".join(parts))
+    fl = state.get("fleet") or {}
+    if fl:
+        head = "fleet:"
+        reps = fl.get("replicas") or {}
+        if reps:
+            up = sum(
+                1 for r in reps.values() if r.get("state") == "up"
+            )
+            head += f" {up}/{len(reps)} up"
+        counters = "  ".join(
+            f"{k}={fl[k]}"
+            for k in ("routed", "shed", "failover", "hedges")
+            if fl.get(k) is not None
+        )
+        if counters:
+            head += "  " + counters
+        lines.append(head)
+        for rid in sorted(reps, key=str):
+            r = reps[rid]
+            port = f" :{r['port']}" if r.get("port") else ""
+            restarts = (
+                f"  restarts={r['restarts']}" if r.get("restarts") else ""
+            )
+            lines.append(
+                f"  r{rid}{port}  {r.get('state', '?')}{restarts}"
+            )
+        if fl.get("events"):
+            lines.append(
+                "  "
+                + "  ".join(
+                    f"{k.removeprefix('fleet_')}={v}"
+                    for k, v in sorted(fl["events"].items())
+                )
+            )
     if state["plan_decisions"] or state.get("plan_streams"):
         parts = []
         if state["plan_decisions"]:

@@ -85,6 +85,18 @@ SITES: dict[str, str] = {
     "serve.swap_fail": "fail a model hot-swap after the candidate "
     "compiled but before commit — the server must keep serving the "
     "prior version and say so (learn/swap.py; key = swap index)",
+    "fleet.replica_kill": "SIGKILL the replica the keyed router request "
+    "is about to dispatch to — the sudden-replica-death drill: the "
+    "router must fail the request over and the fleet supervisor must "
+    "relaunch the replica (serve/fleet.py; key = router request id; "
+    "checked once per request, never on failover retries)",
+    "fleet.slow_replica": "inject KEYSTONE_SERVE_SLOW_MS of extra "
+    "latency into the keyed router request's first dispatch — the "
+    "hedged-dispatch drill (serve/fleet.py; key = router request id)",
+    "fleet.conn_reset": "reset the connection of the keyed router "
+    "request's first dispatch (ConnectionResetError before any bytes "
+    "reach the replica) — the failover drill (serve/fleet.py; key = "
+    "router request id)",
 }
 
 

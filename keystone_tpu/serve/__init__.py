@@ -27,6 +27,12 @@ substrate the earlier subsystems laid down:
   request-path watchdog, and ``observe/`` per-request telemetry
   (latency percentiles via the Timer reservoir, queue-depth /
   batch-fill gauges, a serving panel in ``observe top``).
+- :mod:`.fleet` — the **fault-tolerant tier** over N such servers
+  (``python -m keystone_tpu fleet``): health-aware least-loaded
+  routing, per-request failover + circuit breakers + optional hedging,
+  bounded admission with load shedding, replica supervision with
+  relaunch, and zero-downtime rolling restarts over the SIGTERM-drain
+  contract (``fleet restart``).
 """
 
 from __future__ import annotations

@@ -196,7 +196,7 @@ class ServeApp:
 
     # ----------------------------------------------------------- request
 
-    def predict(self, rows) -> np.ndarray:
+    def predict(self, rows, parent=None) -> np.ndarray:
         if self.batcher is None:
             raise ValueError("no pipeline exported on this server")
         t0 = time.perf_counter()
@@ -210,10 +210,13 @@ class ServeApp:
         # the request's root span: queue-wait / dispatch / device spans
         # recorded by the batcher (its thread) parent on this context.
         # ONE global read per request with no sink active — the hot-path
-        # contract the spans test pins.
+        # contract the spans test pins. ``parent`` adopts an upstream
+        # hop's (trace, span) — the fleet router injects it via the
+        # X-Keystone-Trace header, so one trace spans router → replica.
+        span_kw = {} if parent is None else {"parent": parent}
         try:
             with self._bracket(), _spans.span(
-                "serve.request", rid=rid, kind="predict"
+                "serve.request", rid=rid, kind="predict", **span_kw
             ):
                 # submit under the model lock: a hot-swap replaces the
                 # batcher under the same lock, so this request lands on
@@ -234,7 +237,9 @@ class ServeApp:
             shadow.observe(rows, out, rid=rid)
         return out
 
-    def generate(self, prompt, max_new: int | None = None) -> np.ndarray:
+    def generate(
+        self, prompt, max_new: int | None = None, parent=None
+    ) -> np.ndarray:
         if self.loop is None:
             raise ValueError("no LM decode pool on this server")
         t0 = time.perf_counter()
@@ -245,9 +250,10 @@ class ServeApp:
                 time.perf_counter() - t0, shed=True
             )
             raise
+        span_kw = {} if parent is None else {"parent": parent}
         try:
             with self._bracket(), _spans.span(
-                "serve.request", rid=rid, kind="generate"
+                "serve.request", rid=rid, kind="generate", **span_kw
             ):
                 fut = self.loop.submit(prompt, max_new=max_new, rid=rid)
                 out = np.asarray(fut.result(timeout=_request_timeout_s()))
@@ -290,6 +296,11 @@ class ServeApp:
         th = snap.get("serve_http_seconds") or {}
         out = {
             "status": "draining" if self._stop.is_set() else "ok",
+            # explicit boolean the fleet router keys routing off: set the
+            # MOMENT SIGTERM drain begins (before the batcher drains, long
+            # before the socket closes) so an upstream router stops
+            # sending work to a replica that is on its way out
+            "draining": self._stop.is_set(),
             "requests": snap.get("serve_requests", 0)
             + snap.get("serve_decode_requests", 0),
             "batches": snap.get("serve_batches", 0),
@@ -426,6 +437,26 @@ class OverloadShed(RuntimeError):
     """Admission refused this request (the 503 path)."""
 
 
+def write_metrics_response(handler) -> None:
+    """The ONE home of the /metrics negotiation rule, shared by the
+    replica server and the fleet router: Prometheus 0.0.4 text
+    exposition by default (what a scraper expects), the JSON snapshot
+    behind ``Accept: application/json``."""
+    reg = _metrics.get_registry()
+    accept = handler.headers.get("Accept") or ""
+    if "application/json" in accept:
+        body = json.dumps({"metrics": reg.snapshot()}).encode()
+        ctype = "application/json"
+    else:
+        body = reg.to_prometheus().encode()
+        ctype = "text/plain; version=0.0.4; charset=utf-8"
+    handler.send_response(200)
+    handler.send_header("Content-Type", ctype)
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
 def _handler_for(app: ServeApp):
     class Handler(BaseHTTPRequestHandler):
         # suppress the default per-request stderr lines; metrics and the
@@ -453,19 +484,7 @@ def _handler_for(app: ServeApp):
                     return self._send(404, {"shadowing": False})
                 return self._send(200, shadow.verdict())
             if self.path == "/metrics":
-                # Prometheus text exposition by default (what a scraper
-                # expects); the JSON snapshot stays available behind
-                # Accept: application/json for humans and the tests
-                accept = self.headers.get("Accept") or ""
-                if "application/json" in accept:
-                    return self._send(
-                        200, {"metrics": _metrics.get_registry().snapshot()}
-                    )
-                return self._send_text(
-                    200,
-                    _metrics.get_registry().to_prometheus(),
-                    "text/plain; version=0.0.4; charset=utf-8",
-                )
+                return write_metrics_response(self)
             return self._send(
                 404,
                 {
@@ -487,15 +506,25 @@ def _handler_for(app: ServeApp):
                 return self._send(400, {"error": "invalid JSON body"})
             if self.path.startswith("/admin/"):
                 return self._admin(body)
+            # adopt an upstream trace: the fleet router injects
+            # "X-Keystone-Trace: <trace>:<span>" on the hop, and the
+            # request's serve.request span parents on it — one causal
+            # tree spans router queue → replica queue → device compute
+            parent = None
+            raw_trace = self.headers.get("X-Keystone-Trace") or ""
+            if ":" in raw_trace:
+                t, _, s = raw_trace.partition(":")
+                if t and s:
+                    parent = _spans.SpanContext(t, s)
             try:
                 if self.path == "/predict":
                     rows = np.asarray(body.get("rows"), np.float32)
-                    out = app.predict(rows)
+                    out = app.predict(rows, parent=parent)
                     payload = {"predictions": out.tolist()}
                 elif self.path == "/generate":
                     prompt = body.get("prompt")
                     out = app.generate(
-                        prompt, max_new=body.get("max_new")
+                        prompt, max_new=body.get("max_new"), parent=parent
                     )
                     payload = {"tokens": out.tolist()}
                 else:
@@ -643,6 +672,8 @@ options:
   --buckets A,B,..  compiled batch buckets (default KEYSTONE_SERVE_BUCKETS)
   --deadline-ms F   micro-batch SLO deadline (default KEYSTONE_SERVE_DEADLINE_MS)
   --synthetic N     mnist demo fit size (default 2048)
+  --num-ffts N      mnist demo featurizer count (default 16; small = a
+                    seconds-fast replica boot for fleet drills/bench)
   --slots N         lm decode slots (default 8)
   --max-new N       lm default tokens per request (default 64)
   --s-max N         lm pool sequence capacity (default 256)
@@ -661,6 +692,7 @@ def _parse(argv: list[str]) -> tuple[str, dict]:
     valued = {
         "--port": "port", "--host": "host", "--buckets": "buckets",
         "--deadline-ms": "deadline_ms", "--synthetic": "synthetic",
+        "--num-ffts": "num_ffts",
         "--slots": "slots", "--max-new": "max_new", "--s-max": "s_max",
         "--dim": "dim", "--depth": "depth", "--heads": "heads",
         "--vocab": "vocab", "--seed": "seed", "--input-dim": "input_dim",
@@ -694,7 +726,10 @@ def build_app(target: str, args: dict) -> ServeApp:
     from keystone_tpu.learn.swap import ModelSwapper, version_of
 
     if target in ("mnist", "mnist-random-fft"):
-        pipe, sample = _fit_mnist_demo(int(args.get("synthetic", 2048)))
+        pipe, sample = _fit_mnist_demo(
+            int(args.get("synthetic", 2048)),
+            num_ffts=int(args.get("num_ffts", 16)),
+        )
         exported = export_pipeline(pipe, sample, buckets=buckets)
         app = ServeApp(
             exported=exported,
@@ -765,7 +800,11 @@ def main(argv: list[str] | None = None) -> None:
 
     def _term(signum, frame):
         # drain from a helper thread: shutdown() must not run on the
-        # serve_forever thread (it joins that loop)
+        # serve_forever thread (it joins that loop). The stop flag flips
+        # synchronously so /healthz reports draining from the very first
+        # instant of the SIGTERM window — the fleet router's signal to
+        # stop routing here before this socket ever closes.
+        app._stop.set()
         logger.info("signal %d: draining and shutting down", signum)
 
         def stop():
