@@ -86,6 +86,134 @@ def load_tpu_record() -> dict | None:
     except Exception:  # noqa: BLE001
         return None
 
+
+# ---------------------------------------------------------------------------
+# perf-regression gate: `bench.py --check BASELINE.json --tolerance PCT`
+# compares two recorded bench artifacts and exits nonzero on regression,
+# so a CI step can gate on the bench trajectory instead of eyeballing
+# JSON. No jax import — this path must run anywhere, instantly.
+
+_SKIP_METRIC_KEYS = frozenset(
+    {"ts", "timestamp", "saved_ts", "git_sha", "num_devices"}
+)
+
+
+def _metric_leaves(record: dict, prefix: str = "") -> dict[str, float]:
+    """Flatten a bench record to dotted-path → numeric leaves."""
+    out: dict[str, float] = {}
+    for key, val in record.items():
+        if key in _SKIP_METRIC_KEYS:
+            continue
+        path = f"{prefix}.{key}" if prefix else str(key)
+        if isinstance(val, dict):
+            out.update(_metric_leaves(val, path))
+        elif isinstance(val, (int, float)) and not isinstance(val, bool):
+            out[path] = float(val)
+    return out
+
+
+def _metric_direction(path: str) -> str | None:
+    """``higher`` / ``lower`` / None (not comparable) for one metric
+    path — rates and MFUs must not drop, latencies must not grow;
+    anything ambiguous is skipped rather than guessed."""
+    last = path.split(".")[-1]
+    if (
+        last.endswith(("per_s", "per_sec", "per_chip", "_gflops"))
+        or last.startswith(("mfu", "vs_", "speedup", "aggregate_over"))
+        or "tokens_per_s" in last
+        or "samples_per_s" in last
+        or "rows_per_s" in last
+        or last == "value"
+    ):
+        return "higher"
+    if (
+        last.endswith(("_ms", "_s"))
+        or "p50" in last
+        or "p95" in last
+        or "p99" in last
+    ):
+        return "lower"
+    return None
+
+
+def compare_records(
+    baseline: dict, current: dict, tolerance_pct: float
+) -> tuple[list[str], int]:
+    """Regression lines + count of metrics actually compared. A metric
+    present in only one record is skipped (workloads come and go); only
+    a shared metric moving the WRONG way past tolerance regresses."""
+    base = _metric_leaves(baseline)
+    cur = _metric_leaves(current)
+    tol = max(float(tolerance_pct), 0.0) / 100.0
+    regressions: list[str] = []
+    checked = 0
+    for path in sorted(set(base) & set(cur)):
+        direction = _metric_direction(path)
+        if direction is None:
+            continue
+        b, c = base[path], cur[path]
+        if b <= 0:
+            continue
+        checked += 1
+        delta = (c - b) / b
+        if direction == "higher" and c < b * (1.0 - tol):
+            regressions.append(
+                f"REGRESSION {path}: {b:g} -> {c:g} "
+                f"({delta * 100:+.1f}% < -{tolerance_pct:g}%)"
+            )
+        elif direction == "lower" and c > b * (1.0 + tol):
+            regressions.append(
+                f"REGRESSION {path}: {b:g} -> {c:g} "
+                f"({delta * 100:+.1f}% > +{tolerance_pct:g}%)"
+            )
+    return regressions, checked
+
+
+def _load_record_file(path: str) -> dict:
+    with open(path) as f:
+        record = json.load(f)
+    # accept both the raw result dict and the BENCH_TPU_LAST wrapper
+    if isinstance(record.get("result"), dict):
+        record = record["result"]
+    return record
+
+
+def check_main(argv: list[str]) -> int:
+    """``bench.py --check BASELINE.json [--against CURRENT.json]
+    [--tolerance PCT]`` — exit 1 when any shared metric regressed past
+    tolerance (default 5%, current defaults to BENCH_TPU_LAST.json)."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="bench.py --check", add_help=True
+    )
+    parser.add_argument("--check", required=True, metavar="BASELINE.json")
+    parser.add_argument(
+        "--against",
+        default=TPU_CACHE_PATH,
+        metavar="CURRENT.json",
+        help="record to judge (default: BENCH_TPU_LAST.json)",
+    )
+    parser.add_argument("--tolerance", type=float, default=5.0)
+    args = parser.parse_args(argv)
+    try:
+        baseline = _load_record_file(args.check)
+        current = _load_record_file(args.against)
+    except (OSError, ValueError) as e:
+        print(f"bench --check: {e}", file=sys.stderr)
+        return 2
+    regressions, checked = compare_records(
+        baseline, current, args.tolerance
+    )
+    for line in regressions:
+        print(line)
+    print(
+        f"bench --check: {checked} metric(s) compared, "
+        f"{len(regressions)} regression(s) past {args.tolerance:g}% "
+        f"({args.check} vs {args.against})"
+    )
+    return 1 if regressions else 0
+
 N_TRAIN = 60_000
 IMAGE_SIZE = 784
 NUM_FFTS = 4
@@ -1013,6 +1141,77 @@ def bench_goodput() -> dict:
     return out
 
 
+def bench_autotune(
+    n_items: int = 48, decode_s: float = 0.004, compute_s: float = 0.001
+) -> dict:
+    """Self-tuning-runtime record (plan/tune.py + the ingest frontier):
+    a synthetic HOST-BOUND stream — each item costs ``decode_s`` of
+    host-side decode against ``compute_s`` of consumer work — run once
+    static (one ingest worker, no controller) and once under the
+    autotuner. The tuned run must attribute the dominant wait_host
+    stall, raise the ingest-worker knob, and end with tuned throughput
+    ≥ static and a lower wait_host share — the acceptance numbers this
+    record carries. Pure host work: runs identically on the CPU
+    fallback."""
+    import time as _t
+
+    from keystone_tpu.loaders.streaming import ingest_frontier
+    from keystone_tpu.plan import tune as tune_mod
+
+    def decode(i):
+        _t.sleep(decode_s)
+        return i
+
+    def drive(workers) -> float:
+        t0 = _t.perf_counter()
+        for _ in ingest_frontier(
+            range(n_items), decode, workers=workers, span_name=None
+        ):
+            _t.sleep(compute_s)
+        return _t.perf_counter() - t0
+
+    prev_enabled = tune_mod.active()
+    try:
+        tune_mod.configure(None)  # static: no controller, serial decode
+        static_wall = drive(workers=1)
+
+        tuner = tune_mod.Autotuner(
+            tune_mod.TuneConfig(
+                window_s=0.03, cooldown_s=0.03, min_share=0.2
+            )
+        )
+        tuner.register(
+            tune_mod.value_knob("ingest_workers", 1, lo=1, hi=8, scale=2)
+        )
+        tune_mod.configure(tuner)
+        tuned_wall = drive(workers=None)  # None → the live knob
+        tuner.tick(force=True)  # close out the final partial window
+    finally:
+        tune_mod.configure(prev_enabled)
+
+    hist = list(tuner.history)
+    waits = [
+        h["shares"].get("wait_host", 0.0) for h in hist if h.get("shares")
+    ]
+    actions: dict[str, int] = {}
+    for h in hist:
+        a = h.get("action")
+        if a:
+            actions[a] = actions.get(a, 0) + 1
+    return {
+        "items": n_items,
+        "decode_ms": decode_s * 1e3,
+        "static_items_per_s": round(n_items / static_wall, 1),
+        "tuned_items_per_s": round(n_items / tuned_wall, 1),
+        "tuned_over_static": round(static_wall / tuned_wall, 2),
+        "wait_host_share_first": round(waits[0], 4) if waits else None,
+        "wait_host_share_last": round(waits[-1], 4) if waits else None,
+        "final_ingest_workers": tuner.value("ingest_workers"),
+        "windows": len(hist),
+        "decisions": actions,
+    }
+
+
 def bench_refit_latency(
     n_base: int | None = None,
     chunk_rows: int | None = None,
@@ -1510,9 +1709,13 @@ def _device_peak() -> float | None:
     return peak_flops_for(jax.devices()[0].device_kind)
 
 
-def main() -> None:
+def main(argv: list[str] | None = None) -> int | None:
     global N_TRAIN, CIFAR_N, TIMIT_N, TIMIT_D, SIFT_N
 
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--check" in argv:
+        # the perf-regression gate: pure JSON compare, no jax, no bench
+        return check_main(argv)
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     # a cpu-pinned environment (e.g. the mid-run-failure rerun child)
     # cannot have an accelerator: skip the multi-attempt probe entirely
@@ -1689,6 +1892,14 @@ def main() -> None:
         result["goodput"] = bench_goodput()
     except Exception as e:  # noqa: BLE001 — same contract as above
         result["goodput"] = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+    # self-tuning record (plan/tune.py + ingest frontier): a synthetic
+    # host-bound stream static vs autotuned — wait_host share drop,
+    # final ingest-worker count, and tuned/static throughput ratio; pure
+    # host work, runs everywhere
+    try:
+        result["autotune"] = bench_autotune()
+    except Exception as e:  # noqa: BLE001 — same contract as above
+        result["autotune"] = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
     # fused streaming-fit record (plan/fused_fit.py): streamed-vs-
     # materialized fit delta + chosen Gram operator + rows/s — the
     # solver-MFU trajectory the next chip session reads, runs on the

@@ -100,10 +100,12 @@ def main(argv: list[str] | None = None) -> None:
             f" are also accepted; --multihost joins this process into the\n"
             f" jax.distributed runtime before dispatch — run the same command"
             f" on every host; --observe DIR writes a structured per-node\n"
-            f" event log there, rendered by `observe <dir>` and tailed live"
-            f" by\n `observe top <dir>`; `faults --list`\n"
+            f" event log there, rendered by `observe <dir>`, tailed live by\n"
+            f" `observe top <dir>`, and compared across runs by\n"
+            f" `observe diff <dirA> <dirB>`; `faults --list`\n"
             f" prints the KEYSTONE_FAULTS injection sites; `plan <model>`\n"
-            f" prints the cost-based planner's chosen plan without executing;\n"
+            f" prints the cost-based planner's chosen plan without executing\n"
+            f" (`--learned` shows the KEYSTONE_PLAN_STORE record instead);\n"
             f" `supervise -- CMD` relaunches a multihost job on host loss —\n"
             f" see `supervise --help`; `serve <model> [--port N]` serves a\n"
             f" fitted pipeline or LM over HTTP/JSON — see `serve --help`;\n"

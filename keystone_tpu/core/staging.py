@@ -63,6 +63,23 @@ def default_stage_depth() -> int:
     return _DEFAULT_DEPTH
 
 
+def tune_active():
+    """The active autotuner (:mod:`keystone_tpu.plan.tune`), or None —
+    WITHOUT importing the plan package on untuned processes: the import
+    only happens when ``KEYSTONE_TUNE`` is set or a tuner was already
+    installed programmatically (module present in ``sys.modules``).
+    The one gate every tuner-fed hot path shares (staging, the ingest
+    frontier, the LM train loop)."""
+    import sys as _sys
+
+    mod = _sys.modules.get("keystone_tpu.plan.tune")
+    if mod is None:
+        if not os.environ.get("KEYSTONE_TUNE", "").strip():
+            return None
+        from keystone_tpu.plan import tune as mod
+    return mod.active()
+
+
 def _nbytes(chunk: Any) -> int:
     total = 0
     for leaf in jax.tree_util.tree_leaves(chunk):
@@ -171,6 +188,7 @@ def stage_chunks(
     # not flow into the worker — every h2d span parents on it explicitly
     span_log = _spans.active_span_log()
     parent_ctx = _spans.current() if span_log is not None else None
+    tuner = tune_active()  # once per stream, like the span log
 
     def place(chunk: Any, valid: int) -> tuple[Any, bool]:
         spec = sharding(chunk) if callable(sharding) else sharding
@@ -181,6 +199,12 @@ def stage_chunks(
             else jax.device_put(chunk)
         )
         owned = _placement_owned(staged, chunk)
+        if owned and tuner is not None:
+            # h2d transfer wall feeds the wait_host attribution the
+            # self-tuning controller acts on
+            tuner.observe(
+                bucket="wait_host", wall_s=_time.perf_counter() - t0
+            )
         if owned and span_log is not None:
             # only real transfers become spans (same rule as the
             # counters below); with depth > 0 they run on the staging
@@ -309,6 +333,7 @@ def run_staged(
     # device-wait spans parent naturally; looked up once per stream
     span_log = _spans.active_span_log()
     wait_parent = _spans.current() if span_log is not None else None
+    tuner = tune_active()
 
     def force(item: tuple[Any, Any, int, bool]) -> Any:
         staged, out, valid, owned = item
@@ -320,6 +345,14 @@ def run_staged(
         else:
             out = jax.block_until_ready(out)
             forced = jax.tree_util.tree_map(lambda a: a[:valid], out)
+        if tuner is not None:
+            # device-wait stall + completed rows: the wait_device signal
+            # (→ smaller chunks) and the goodput denominator in one feed
+            tuner.observe(
+                bucket="wait_device",
+                wall_s=_time.perf_counter() - t0,
+                rows=valid,
+            )
         if span_log is not None:
             # the stall signal the self-tuning planner wants: how long
             # the host actually blocked on the device for this chunk
@@ -374,9 +407,15 @@ def fold_staged(
     staged_iter = stage_chunks(chunks, sharding=sharding, depth=stage_depth)
     state = init
     pending: deque = deque()  # staged inputs of dispatched updates
+    tuner = tune_active()
 
     def drain(state):
+        t0 = _time.perf_counter()
         state = jax.block_until_ready(state)
+        if tuner is not None:
+            tuner.observe(
+                bucket="wait_device", wall_s=_time.perf_counter() - t0
+            )
         while pending:
             free_buffers(pending.popleft(), keep=state)
         return state
@@ -384,6 +423,8 @@ def fold_staged(
     try:
         for staged, valid, owned in staged_iter:
             state = fn(state, staged, valid)
+            if tuner is not None:
+                tuner.observe(rows=valid)
             if free_inputs and owned:
                 pending.append(staged)
             if len(pending) > max(inflight, 0):

@@ -22,7 +22,8 @@ TPU-native equivalent here:
 
 from __future__ import annotations
 
-from typing import Callable, Iterable, Iterator
+import os
+from typing import Any, Callable, Iterable, Iterator
 
 import numpy as np
 
@@ -33,13 +34,134 @@ from keystone_tpu.loaders.image_loaders import (
     decode_image,
 )
 
+ENV_INGEST_WORKERS = "KEYSTONE_INGEST_WORKERS"
+# the frontier's thread-pool ceiling; the LIVE worker count (≤ this)
+# bounds how many decodes are actually in flight
+_INGEST_POOL_MAX = 16
+
+
+def default_ingest_workers() -> int:
+    """Decode parallelism when no autotuner drives it:
+    ``KEYSTONE_INGEST_WORKERS``, else 8 (the historical tar-decode pool
+    width)."""
+    raw = os.environ.get(ENV_INGEST_WORKERS, "").strip()
+    if raw:
+        try:
+            return max(int(raw), 1)
+        except ValueError:
+            pass
+    return 8
+
+
+def _live_workers() -> int:
+    """The ingest-frontier worker count: the autotuner's
+    ``ingest_workers`` knob when one is active (the wait_host ⇒ more
+    ingest parallelism feedback loop), else the env/static default."""
+    from keystone_tpu.core.staging import tune_active
+
+    tuner = tune_active()
+    if tuner is not None:
+        v = tuner.value("ingest_workers")
+        if v:
+            return int(v)
+    return default_ingest_workers()
+
+
+def ingest_frontier(
+    items: Iterable,
+    fn: Callable,
+    *,
+    workers: int | Callable[[], int] | None = None,
+    pool: int = _INGEST_POOL_MAX,
+    span_name: str | None = "ingest.wait_host",
+) -> Iterator[Any]:
+    """Map ``fn`` over ``items`` with a bounded multi-worker decode pool
+    running AHEAD of the consumer, yielding results in input order —
+    bit-exact vs ``(fn(i) for i in items)``, exceptions re-raised at the
+    consumer in order.
+
+    This is the async ingest frontier of the self-tuning runtime: up to
+    the *current* worker count of decodes are in flight ahead of the
+    consumer (``workers`` — an int, a callable polled at each refill, or
+    None for the live autotuner knob / ``KEYSTONE_INGEST_WORKERS``), so
+    host-side tar-read + decode stops gating accelerator feed. The time
+    the consumer actually blocks waiting for the next decoded item is
+    the wait_host stall: it feeds the active autotuner (which raises the
+    worker count when that stall dominates) and — when a span log is
+    active and the wait is non-trivial — one ``ingest.wait_host`` span,
+    so goodput reports attribute ingest-bound time correctly.
+    """
+    import concurrent.futures
+    import time as _time
+    from collections import deque
+
+    from keystone_tpu.core.staging import tune_active
+    from keystone_tpu.observe import spans as _spans
+
+    if workers is None:
+        workers_fn: Callable[[], int] = _live_workers
+    elif callable(workers):
+        workers_fn = workers
+    else:
+        fixed = max(int(workers), 1)
+        workers_fn = lambda: fixed  # noqa: E731
+
+    tuner = tune_active()
+    span_log = _spans.active_span_log() if span_name else None
+    parent_ctx = _spans.current() if span_log is not None else None
+
+    def gen() -> Iterator[Any]:
+        ex = concurrent.futures.ThreadPoolExecutor(max_workers=max(pool, 1))
+        it = iter(items)
+        pending: deque = deque()
+        exhausted = [False]
+
+        def refill() -> None:
+            # the knob is polled HERE, so a retuned worker count takes
+            # effect at the next refill — no pool rebuild, no drain
+            target = max(1, min(int(workers_fn()), pool))
+            while not exhausted[0] and len(pending) < target:
+                try:
+                    item = next(it)
+                except StopIteration:
+                    exhausted[0] = True
+                    return
+                pending.append(ex.submit(fn, item))
+
+        try:
+            refill()
+            while pending:
+                fut = pending.popleft()
+                t0 = _time.perf_counter()
+                result = fut.result()  # re-raises fn's exception in order
+                waited = _time.perf_counter() - t0
+                if tuner is not None:
+                    tuner.observe(
+                        bucket="wait_host", wall_s=waited, rows=1
+                    )
+                if span_log is not None and waited > 1e-3:
+                    span_log.record_span(
+                        span_name,
+                        wall_s=waited,
+                        bucket="wait_host",
+                        parent=parent_ctx,
+                    )
+                refill()
+                yield result
+        finally:
+            for fut in pending:
+                fut.cancel()
+            ex.shutdown(wait=False)
+
+    return gen()
+
 
 def iter_tar_image_batches(
     paths: list[str] | str,
     *,
     batch_size: int = 512,
     target_size: int | None = 256,
-    workers: int = 8,
+    workers: int | None = None,
     name_prefix: str | None = None,
     process_index: int = 0,
     process_count: int = 1,
@@ -57,41 +179,20 @@ def iter_tar_image_batches(
     ``ingest_archives_skipped`` counter, and per-image decode failures
     count under ``ingest_decode_failures`` (see
     :mod:`keystone_tpu.resilience`).
-    """
-    import concurrent.futures
 
+    Decode runs through the async ingest frontier
+    (:func:`ingest_frontier`): up to the live worker count of images are
+    decoded AHEAD of batch assembly (across batch boundaries), and the
+    count is retunable mid-stream — ``workers=None`` follows the
+    autotuner's ``ingest_workers`` knob / ``KEYSTONE_INGEST_WORKERS``.
+    Batch boundaries are drawn every ``batch_size`` tar ENTRIES (decode
+    failures then dropped), matching the historical grouping exactly.
+    """
     if isinstance(paths, str):
         paths = _expand(paths, ".tar")
     paths = list(paths)[process_index::process_count]
 
-    def decode(nd):
-        try:
-            return decode_image(nd[1], target_size)
-        except Exception as e:  # noqa: BLE001 — PIL raises various types
-            _logger().warning("failed to decode %s: %s", nd[0], e)
-            _count_decode_failure("streaming")
-            return None
-
-    with concurrent.futures.ThreadPoolExecutor(workers) as ex:
-        pending: list[tuple[str, bytes, int]] = []
-
-        def flush():
-            decoded = list(ex.map(decode, [(n, b) for n, b, _ in pending]))
-            names, imgs, labels = [], [], []
-            for (n, _, lab), img in zip(pending, decoded):
-                if img is not None:
-                    names.append(n)
-                    imgs.append(img)
-                    labels.append(lab)
-            pending.clear()
-            if not imgs:
-                return None
-            return (
-                names,
-                np.stack(imgs),
-                np.asarray(labels, np.int32) if label_of else None,
-            )
-
+    def entries() -> Iterator[tuple[str, bytes, int]]:
         for p in paths:
             for name, data in _iter_tar_images(p):
                 if name_prefix is not None and not name.startswith(
@@ -101,15 +202,53 @@ def iter_tar_image_batches(
                 lab = label_of(name) if label_of else 0
                 if label_of and lab < 0:
                     continue
-                pending.append((name, data, lab))
-                if len(pending) >= batch_size:
-                    out = flush()
-                    if out is not None:
-                        yield out
-        if pending:
-            out = flush()
-            if out is not None:
-                yield out
+                yield (name, data, lab)
+
+    def decode_one(entry):
+        name, data, lab = entry
+        try:
+            return name, decode_image(data, target_size), lab
+        except Exception as e:  # noqa: BLE001 — PIL raises various types
+            _logger().warning("failed to decode %s: %s", name, e)
+            _count_decode_failure("streaming")
+            return name, None, lab
+
+    names: list[str] = []
+    imgs: list[np.ndarray] = []
+    labels: list[int] = []
+    seen = 0
+
+    def assemble():
+        out = (
+            list(names),
+            np.stack(imgs),
+            np.asarray(labels, np.int32) if label_of else None,
+        )
+        names.clear()
+        imgs.clear()
+        labels.clear()
+        return out
+
+    decoded = ingest_frontier(
+        entries(), decode_one, workers=workers, span_name=None
+    )
+    try:
+        for name, img, lab in decoded:
+            seen += 1
+            if img is not None:
+                names.append(name)
+                imgs.append(img)
+                labels.append(lab)
+            if seen >= batch_size:
+                seen = 0
+                if imgs:
+                    yield assemble()
+        if imgs:
+            yield assemble()
+    finally:
+        close = getattr(decoded, "close", None)
+        if close is not None:
+            close()
 
 
 class ColumnReservoir:

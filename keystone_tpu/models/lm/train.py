@@ -530,6 +530,12 @@ def train(
     # tracer check.
     step_flops = train_step_flops(model, batch, seq)
     devmon = _observe_devices.DeviceMemoryMonitor()
+    # the self-tuning controller (KEYSTONE_TUNE=1): per-step host-vs-
+    # compute walls + token goodput feed its rolling attribution window.
+    # tune_active is the cheap gate — no plan import on untuned runs.
+    from keystone_tpu.core.staging import tune_active as _tune_active
+
+    tuner = _tune_active()
     tracer = _tracing.StepTracer.from_env(
         install_signal=(
             _threading.current_thread() is _threading.main_thread()
@@ -582,12 +588,24 @@ def train(
             completed = i + 1
             _cluster.note_step(completed)
             steplog = _telemetry.active_step_log()
-            if steplog is not None:
+            if steplog is not None or tuner is not None:
                 # the float() below is the one per-step host sync the
-                # live stream pays — measure the wall AFTER it so the
-                # recorded step time is honest under async dispatch
+                # live stream (and honest tuner walls) pays — measure
+                # the wall AFTER it so the recorded step time is honest
+                # under async dispatch
                 loss_f = float(loss)
                 wall = _time.perf_counter() - t_step0
+                if tuner is not None:
+                    # host-batch vs dispatched-compute attribution +
+                    # token goodput for the self-tuning window
+                    tuner.observe(
+                        rows=batch * seq,
+                        buckets={
+                            "wait_host": t_host,
+                            "compute": max(wall - t_host, 0.0),
+                        },
+                    )
+            if steplog is not None:
                 steplog.step(
                     step=i + 1,
                     loss=loss_f,

@@ -50,6 +50,7 @@ def summarize(events: list[dict]) -> dict[str, Any]:
     fleets: list[dict] = []
     swaps: list[dict] = []
     refits: list[dict] = []
+    tunes: list[dict] = []
     alerts: list[dict] = []
     device_memory: dict | None = None
     trace_windows: list[dict] = []
@@ -93,6 +94,8 @@ def summarize(events: list[dict]) -> dict[str, Any]:
             swaps.append(ev)
         elif kind == "refit":
             refits.append(ev)
+        elif kind == "tune":
+            tunes.append(ev)
         elif kind == "alert":
             alerts.append(ev)
         elif kind == "device_memory":
@@ -114,6 +117,7 @@ def summarize(events: list[dict]) -> dict[str, Any]:
         "fleet": fleets,
         "model_swaps": swaps,
         "refits": refits,
+        "tunes": tunes,
         "alerts": alerts,
         "device_memory": device_memory,
         "trace_windows": trace_windows,
@@ -294,6 +298,7 @@ def render(run_dir: str) -> str:
                 )
                 lines.append(f"  {ev.get('action', '?')}: {fields}")
             lines.append("")
+    lines.extend(_tune_section(summary))
     lines.extend(_alert_section(run_dir, summary))
     lines.extend(_goodput_section(run_dir))
     lines.extend(_telemetry_sections(run_dir, summary))
@@ -303,6 +308,41 @@ def render(run_dir: str) -> str:
             "roofline basis: ROOFLINE.md)"
         )
     return "\n".join(lines)
+
+
+def _tune_section(summary: dict) -> list[str]:
+    """The self-tuning controller's record: decision counts by action,
+    the converged knob values, and the last few adjustments."""
+    tunes = summary.get("tunes") or []
+    if not tunes:
+        return []
+    by_action: dict[str, int] = {}
+    knobs: dict | None = None
+    for ev in tunes:
+        action = str(ev.get("action", "?"))
+        by_action[action] = by_action.get(action, 0) + 1
+        if isinstance(ev.get("knobs"), dict):
+            knobs = ev["knobs"]
+    lines = [
+        "autotuner (self-tuning decisions): "
+        + "  ".join(f"{k}={v}" for k, v in sorted(by_action.items()))
+    ]
+    if knobs:
+        lines.append(
+            "  knobs: "
+            + "  ".join(f"{k}={v}" for k, v in sorted(knobs.items()))
+        )
+    moves = [ev for ev in tunes if ev.get("action") != "hold"]
+    for ev in moves[-6:]:
+        fields = ", ".join(
+            f"{k}={v}"
+            for k, v in ev.items()
+            if k not in ("event", "ts", "run", "action", "knobs")
+            and v is not None
+        )
+        lines.append(f"  {ev.get('action', '?')}: {fields}")
+    lines.append("")
+    return lines
 
 
 def _alert_section(run_dir: str, summary: dict) -> list[str]:
@@ -613,8 +653,184 @@ def per_node_breakdown(
     return out
 
 
+# ------------------------------------------------------------- run diff
+
+
+def _diff_profile(run_dir: str) -> dict[str, Any]:
+    """One run's comparable summary: goodput bucket shares (spans),
+    train step-wall percentiles + rates (steps.jsonl), and per-kind /
+    per-action event counts — the three axes ``observe diff`` renders."""
+    from keystone_tpu.observe import spans as _spans
+    from keystone_tpu.observe import telemetry as _telemetry
+    from keystone_tpu.observe import top as _top
+    from keystone_tpu.observe.metrics import percentiles
+
+    run_dir = _top.resolve_run_dir(run_dir)
+    out: dict[str, Any] = {
+        "dir": run_dir,
+        "goodput": None,
+        "steps": {},
+        "counts": {},
+    }
+    try:
+        span_recs = _spans.read_spans(run_dir)
+    except OSError:
+        span_recs = []
+    if span_recs:
+        out["goodput"] = _spans.goodput_summary(span_recs)
+    steps_path = os.path.join(run_dir, _telemetry.STEPS_FILE)
+    if os.path.isfile(steps_path) or os.path.isfile(steps_path + ".1"):
+        recs = _events.read_jsonl_rotated(steps_path)
+        train = [
+            r
+            for r in recs
+            if "step" in r and r.get("source", "train") == "train"
+        ]
+        walls = [
+            r["wall_s"]
+            for r in train
+            if isinstance(r.get("wall_s"), (int, float))
+        ]
+        st: dict[str, Any] = {"n": len(train)}
+        if walls:
+            st["wall_p"] = percentiles(walls, (50, 95, 99))
+        rates = [
+            r["tokens_per_s"]
+            for r in train
+            if isinstance(r.get("tokens_per_s"), (int, float))
+        ]
+        if rates:
+            st["tokens_per_s_best"] = max(rates)
+        stream_rates = [
+            r["rows_per_s"]
+            for r in recs
+            if r.get("source") in ("plan", "solver")
+            and isinstance(r.get("rows_per_s"), (int, float))
+        ]
+        if stream_rates:
+            st["rows_per_s_best"] = max(stream_rates)
+        out["steps"] = st
+    try:
+        events = _events.read_events(run_dir)
+    except OSError:
+        events = []
+    counts: dict[str, int] = {}
+    for ev in events:
+        kind = str(ev.get("event", "?"))
+        counts[kind] = counts.get(kind, 0) + 1
+        if ev.get("action") and kind in (
+            "resilience",
+            "cluster",
+            "alert",
+            "tune",
+            "model_swap",
+            "refit",
+            "serve",
+        ):
+            key = f"{kind}.{ev['action']}"
+            counts[key] = counts.get(key, 0) + 1
+    out["counts"] = counts
+    return out
+
+
+def render_diff(dir_a: str, dir_b: str) -> str:
+    """``observe diff <dirA> <dirB>``: side-by-side goodput shares,
+    step-time percentiles, and event-counter deltas between two run
+    dirs — the tuned-vs-static comparison, by hand."""
+    a = _diff_profile(dir_a)
+    b = _diff_profile(dir_b)
+    lines = [
+        f"A: {a['dir']}",
+        f"B: {b['dir']}",
+        "",
+    ]
+    ga, gb = a["goodput"], b["goodput"]
+    if ga or gb:
+        lines.append(
+            f"goodput shares (A: {len((ga or {}).get('buckets', {}))} "
+            f"bucket(s) over {(ga or {}).get('total_s', 0.0):.3f}s, "
+            f"B: over {(gb or {}).get('total_s', 0.0):.3f}s):"
+        )
+        buckets = sorted(
+            set((ga or {}).get("buckets", {}))
+            | set((gb or {}).get("buckets", {}))
+        )
+        lines.append(f"  {'bucket':12} {'A':>8} {'B':>8} {'Δ':>9}")
+        for bucket in buckets:
+            sa = ((ga or {}).get("buckets", {}).get(bucket) or {}).get(
+                "share", 0.0
+            )
+            sb = ((gb or {}).get("buckets", {}).get(bucket) or {}).get(
+                "share", 0.0
+            )
+            lines.append(
+                f"  {bucket:12} {sa * 100:7.1f}% {sb * 100:7.1f}% "
+                f"{(sb - sa) * 100:+8.1f}pp"
+            )
+        lines.append("")
+    sa, sb = a["steps"], b["steps"]
+    if sa or sb:
+        lines.append(
+            f"steps: A {sa.get('n', 0)} record(s), B {sb.get('n', 0)}"
+        )
+        pa, pb = sa.get("wall_p") or {}, sb.get("wall_p") or {}
+        for q in (50, 95, 99):
+            if q in pa or q in pb:
+                va, vb = pa.get(q), pb.get(q)
+                delta = (
+                    f"{(vb - va) / va * 100:+6.1f}%"
+                    if va and vb is not None
+                    else "      -"
+                )
+                lines.append(
+                    f"  wall p{q:<3} "
+                    f"{_fmt(va, 1e-3, 1):>8} ms {_fmt(vb, 1e-3, 1):>8} ms "
+                    f"{delta}"
+                )
+        for key, label in (
+            ("tokens_per_s_best", "tokens/s best"),
+            ("rows_per_s_best", "rows/s best"),
+        ):
+            va, vb = sa.get(key), sb.get(key)
+            if va is not None or vb is not None:
+                delta = (
+                    f"{(vb - va) / va * 100:+6.1f}%"
+                    if va and vb is not None
+                    else "      -"
+                )
+                lines.append(
+                    f"  {label:12} {_fmt(va, digits=1):>10} "
+                    f"{_fmt(vb, digits=1):>10} {delta}"
+                )
+        lines.append("")
+    keys = sorted(set(a["counts"]) | set(b["counts"]))
+    if keys:
+        lines.append("event counts (A -> B):")
+        for key in keys:
+            ca, cb = a["counts"].get(key, 0), b["counts"].get(key, 0)
+            if ca == cb:
+                continue
+            lines.append(f"  {key:28} {ca:>6} -> {cb:<6} ({cb - ca:+d})")
+        if all(
+            a["counts"].get(k, 0) == b["counts"].get(k, 0) for k in keys
+        ):
+            lines.append("  (identical)")
+    return "\n".join(lines).rstrip()
+
+
 def main(argv: list[str] | None = None) -> None:
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "diff":
+        # tuned-vs-static comparison: `observe diff <dirA> <dirB>`
+        if len(argv) != 3:
+            raise SystemExit(
+                "usage: python -m keystone_tpu observe diff <dirA> <dirB>"
+            )
+        try:
+            print(render_diff(argv[1], argv[2]))
+        except OSError as e:
+            raise SystemExit(str(e)) from None
+        return
     if argv and argv[0] == "top":
         # the live dashboard: `observe top <dir> [--once] [--interval S]`
         from keystone_tpu.observe import top as _top
@@ -632,11 +848,14 @@ def main(argv: list[str] | None = None) -> None:
             " [--interval S]\n"
             "       python -m keystone_tpu observe trace <run-dir>"
             " [--request ID] [--limit N]\n"
+            "       python -m keystone_tpu observe diff <dirA> <dirB>\n"
             "<run-dir> is a directory containing events.jsonl, or a base\n"
             "KEYSTONE_OBSERVE_DIR (the newest run under it is rendered);\n"
             "`top` tails steps.jsonl/events.jsonl as a live dashboard;\n"
             "`trace` renders spans.jsonl as per-trace span trees with a\n"
-            "critical-path summary and the goodput bucket breakdown"
+            "critical-path summary and the goodput bucket breakdown;\n"
+            "`diff` renders side-by-side goodput shares, step-time\n"
+            "percentiles, and event-counter deltas between two runs"
         )
     try:
         print(render(argv[0]))

@@ -49,6 +49,8 @@ EVENT_KINDS: dict[str, str] = {
     "start/stop (learn/swap.py, serve/server.py)",
     "refit": "a refit-daemon decision: chunk folded/skipped, versioned "
     "model published, reload notify (learn/refit.py)",
+    "tune": "an autotuner decision: knob adjust/commit/revert/hold/load "
+    "with the current knob snapshot and window goodput (plan/tune.py)",
 }
 
 _warned: set[str] = set()

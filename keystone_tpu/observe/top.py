@@ -139,6 +139,7 @@ def summarize(steps: list[dict], events: list[dict]) -> dict[str, Any]:
         "trace_windows": 0,
         "serve": {},
         "fleet": {},
+        "tune": {},
         "last_ts": None,
     }
     # the stream mixes sources: train steps (source="train") carry the
@@ -280,6 +281,17 @@ def summarize(steps: list[dict], events: list[dict]) -> dict[str, Any]:
                 sv["swaps"] = sv.get("swaps", 0) + 1
             elif action == "rollback":
                 sv["rollbacks"] = sv.get("rollbacks", 0) + 1
+        elif kind == "tune":
+            # the autotuner panel: current knob snapshot (each event
+            # carries it) + the last non-hold decision
+            tn = out["tune"]
+            tn["decisions"] = tn.get("decisions", 0) + 1
+            action = str(ev.get("action", "?"))
+            tn[action] = tn.get(action, 0) + 1
+            if isinstance(ev.get("knobs"), dict):
+                tn["knobs"] = ev["knobs"]
+            if action != "hold":
+                tn["last"] = ev
         elif kind == "optimize":
             out["plan_decisions"] += len(ev.get("decisions") or []) or 1
         elif kind == "trace_window":
@@ -444,6 +456,26 @@ def render(state: dict[str, Any], run_dir: str) -> str:
                     for k, v in sorted(fl["events"].items())
                 )
             )
+    tn = state.get("tune") or {}
+    if tn:
+        head = "autotuner:"
+        for k, v in sorted((tn.get("knobs") or {}).items()):
+            head += f" {k}={v}"
+        head += (
+            f"  decisions={tn.get('decisions', 0)}"
+            + (f" adjusts={tn['adjust']}" if tn.get("adjust") else "")
+            + (f" reverts={tn['revert']}" if tn.get("revert") else "")
+        )
+        lines.append(head)
+        last = tn.get("last")
+        if last:
+            detail = "  ".join(
+                f"{k}={v}"
+                for k, v in last.items()
+                if k not in ("event", "ts", "run", "action", "knobs")
+                and v is not None
+            )
+            lines.append(f"  last: {last.get('action', '?')}  {detail}")
     if state["plan_decisions"] or state.get("plan_streams"):
         parts = []
         if state["plan_decisions"]:

@@ -129,10 +129,58 @@ def plan_pipeline(
     into data-axis sharded dispatch; the staging pass then also sizes
     the double-buffered host→device transfer depth (``stage_depth`` /
     ``KEYSTONE_STAGE_DEPTH`` override it).
+
+    Self-tuning (both env-gated no-ops by default): with a plan store
+    configured (``KEYSTONE_PLAN_STORE``, :mod:`.store`) the learned
+    record for this (pipeline fingerprint, device kind) seeds chunk
+    size and stage depth — the run starts where the last one converged;
+    with the autotuner active (``KEYSTONE_TUNE=1``, :mod:`.tune`) its
+    live ``chunk_rows`` knob takes precedence over the store, the
+    chosen chunk becomes the knob's seed, and the tuner is bound to the
+    store identity so committed improvements persist. Priority:
+    explicit argument > live autotuner > stored record > cost model,
+    with every seeding recorded as a plan decision (``source=``).
     """
     from keystone_tpu.parallel.mesh import current_mesh
+    from keystone_tpu.plan import store as _plan_store
+    from keystone_tpu.plan import tune as _tune
 
     chain = chain_from(pipe)
+    fp = _plan_store.fingerprint([pn.label for pn in chain])
+    device_kind = _device_kind()
+    learned = None
+    if _plan_store.store_dir():
+        try:
+            learned = _plan_store.load(fp, device_kind=device_kind)
+        except _plan_store.PlanStoreError as e:
+            # refusal is loud but not fatal: plan untuned
+            from keystone_tpu.core.logging import get_logger
+
+            get_logger("keystone_tpu.plan").warning("%s", e)
+    tuner = _tune.active()
+    chunk_req, chunk_source = chunk_size, "requested"
+    if chunk_req is None and tuner is not None:
+        # fingerprint-scoped: only the pipeline that bound the chunk
+        # knob reads it back — another pipeline must not inherit a
+        # chunk tuned for a different working set
+        live = tuner.chunk_value_for(fp)
+        if live:
+            chunk_req, chunk_source = int(live), "autotuner"
+    if chunk_req is None and learned is not None:
+        stored = (learned.get("plan") or {}).get("chunk_size")
+        if stored:
+            chunk_req, chunk_source = int(stored), "store"
+    depth_req, depth_source = stage_depth, "requested"
+    if (
+        depth_req is None
+        and not os.environ.get("KEYSTONE_STAGE_DEPTH", "").strip()
+        and learned is not None
+    ):
+        stored = (learned.get("knobs") or {}).get("stage_depth")
+        if stored is None:
+            stored = (learned.get("plan") or {}).get("stage_depth")
+        if stored is not None:
+            depth_req, depth_source = int(stored), "store"
     probe = _costs.slice_probe(sample) if sample is not None else None
     _costs.attach(chain, probe)
     plan = Plan(
@@ -140,7 +188,7 @@ def plan_pipeline(
         budget_bytes=(
             default_budget_bytes() if budget_bytes is None else budget_bytes
         ),
-        device_kind=_device_kind(),
+        device_kind=device_kind,
         rows=_costs._rows(probe) if probe is not None else 0,
         prefetch=prefetch,
         mesh=mesh if mesh is not None else current_mesh(),
@@ -149,11 +197,42 @@ def plan_pipeline(
     # budget decisions are priced at the REAL execution size, not the
     # profiling-sample size — resident bytes scale with rows
     _passes.choose_materialization(plan, rows=n_rows)
-    if chunk_size is not None or n_rows is not None:
+    if chunk_req is not None or n_rows is not None:
         _passes.choose_chunk_size(
-            plan, n_rows or 0, requested=chunk_size, shards=_shards(plan)
+            plan,
+            n_rows or 0,
+            requested=chunk_req,
+            source=chunk_source,
+            shards=_shards(plan),
         )
-    _passes.choose_staging(plan, n_rows or 0, requested_depth=stage_depth)
+    _passes.choose_staging(
+        plan,
+        n_rows or 0,
+        requested_depth=depth_req,
+        depth_source=depth_source,
+    )
+    if learned is not None:
+        plan.decide(
+            "learned",
+            fingerprint=fp,
+            run=(learned.get("provenance") or {}).get("run"),
+            saved_ts=learned.get("saved_ts"),
+        )
+    if tuner is not None:
+        if plan.chunk_size:
+            tuner.bind_chunk(plan.chunk_size, fingerprint=fp)
+        tuner.bind_store(
+            fp,
+            device_kind,
+            {
+                "chunk_size": plan.chunk_size,
+                "stage_depth": plan.stage_depth,
+                "nodes": [pn.label for pn in plan.prefix],
+            },
+            # the store was already consulted above — pass the payload
+            # through so the hit/mismatch counters count real loads
+            record=learned,
+        )
     _passes.emit_plan(plan)
     return plan
 

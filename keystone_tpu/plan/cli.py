@@ -117,6 +117,13 @@ def main(argv: list[str] | None = None) -> None:
         "accumulation + Gram-operator choice) instead of its apply path",
     )
     parser.add_argument(
+        "--learned",
+        action="store_true",
+        help="show the KEYSTONE_PLAN_STORE record for this model's "
+        "pipeline (final knob settings + provenance) instead of "
+        "re-planning it",
+    )
+    parser.add_argument(
         "--chunk-size", type=int, default=None, help="force executor chunk size"
     )
     parser.add_argument(
@@ -135,6 +142,43 @@ def main(argv: list[str] | None = None) -> None:
     args = parser.parse_args(argv)
 
     from keystone_tpu import plan as plan_mod
+
+    if args.learned:
+        from keystone_tpu.plan import store as plan_store
+        from keystone_tpu.plan.ir import chain_from
+
+        base = plan_store.store_dir()
+        if not base:
+            raise SystemExit(
+                "--learned needs KEYSTONE_PLAN_STORE set to the plan-"
+                "store directory"
+            )
+        pipe, _probe = BUILDERS[args.model]()
+        # the same identity plan_pipeline fingerprints: the pre-rewrite
+        # node-label chain
+        fp = plan_store.fingerprint(
+            [pn.label for pn in chain_from(pipe)]
+        )
+        try:
+            rec = plan_store.load(fp, device_kind=plan_mod._device_kind())
+        except plan_store.PlanStoreError as e:
+            raise SystemExit(str(e)) from None
+        if rec is None:
+            others = plan_store.entries()
+            print(
+                f"{args.model}: no learned plan stored for fingerprint "
+                f"{fp} on this device kind under {base}"
+            )
+            if others:
+                print(f"({len(others)} record(s) for other pipelines/devices:)")
+                for other in others[:8]:
+                    for line in plan_store.describe(other):
+                        print("  " + line)
+            return
+        print(f"{args.model}  [{base}]")
+        for line in plan_store.describe(rec):
+            print(line)
+        return
 
     if args.fit:
         if args.model not in FIT_BUILDERS:

@@ -249,6 +249,7 @@ def choose_chunk_size(
     n_rows: int,
     *,
     requested: int | None = None,
+    source: str = "requested",
     budget_fraction: float = 0.25,
     shards: int = 1,
 ) -> Plan:
@@ -264,8 +265,11 @@ def choose_chunk_size(
     static shape.
     """
     if requested is not None:
+        # ``source`` records where the forced size came from: the
+        # caller ("requested"), a persisted learned plan ("store"), or
+        # the live controller ("autotuner")
         plan.chunk_size = requested
-        plan.decide("chunk", size=requested, source="requested")
+        plan.decide("chunk", size=requested, source=source)
         return plan
     peak_row = max(
         (
@@ -306,6 +310,7 @@ def choose_staging(
     *,
     mesh: Any = None,
     requested_depth: int | None = None,
+    depth_source: str = "requested",
 ) -> Plan:
     """Comms-aware staging + sharding decisions (the transfer half of the
     cost model — KeystoneML priced network shuffles; the TPU analog is
@@ -352,7 +357,7 @@ def choose_staging(
     )
 
     if requested_depth is not None:
-        depth, source = max(int(requested_depth), 0), "requested"
+        depth, source = max(int(requested_depth), 0), depth_source
     elif os.environ.get("KEYSTONE_STAGE_DEPTH", "").strip():
         depth, source = default_stage_depth(), "env"
     elif transfer_s > 0.0 and compute_s > 0.0 and transfer_s > compute_s:

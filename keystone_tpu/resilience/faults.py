@@ -97,6 +97,10 @@ SITES: dict[str, str] = {
     "request's first dispatch (ConnectionResetError before any bytes "
     "reach the replica) — the failover drill (serve/fleet.py; key = "
     "router request id)",
+    "tune.bad_knob": "force an autotuner knob to its worst bound at the "
+    "keyed evaluation window — the revert-guard drill: the next "
+    "window's goodput regression must walk the knob back "
+    "(plan/tune.py; key = evaluation index)",
 }
 
 
