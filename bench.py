@@ -1212,6 +1212,125 @@ def bench_autotune(
     }
 
 
+def bench_obs_overhead(steps: int = 30, matmuls: int = 4) -> dict:
+    """Fleet-observability overhead record (observe/collector.py): the
+    SAME jitted step loop run bare, then fully instrumented — event
+    sink + per-step telemetry writing a run dir that a LIVE collector
+    tails (and whose /metrics it scrapes) every 100 ms from a
+    background thread. The number this pins: whole-system observability
+    — per-step records, file tailing, scraping, SLO evaluation — costs
+    < 5% of throughput on the CPU fallback. Pure host+jit work, runs
+    everywhere."""
+    import tempfile
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    import jax
+    import jax.numpy as jnp
+
+    from keystone_tpu.observe import events as obs_events
+    from keystone_tpu.observe import telemetry as obs_telemetry
+    from keystone_tpu.observe.collector import Collector
+    from keystone_tpu.serve.server import write_metrics_response
+
+    rng = np.random.default_rng(0)
+    # a chunky step (tens of ms on the CPU fallback): the question is
+    # the collector's cost against a REAL training step, not against a
+    # microbenchmark whose wall is all fixed per-step overhead
+    w = rng.normal(size=(2048, 2048)).astype(np.float32) * 0.02
+    x0 = rng.normal(size=(512, 2048)).astype(np.float32)
+
+    @jax.jit
+    def step_fn(x):
+        for _ in range(matmuls):
+            x = jnp.tanh(x @ w)
+        return x
+
+    x = jax.device_put(x0)
+    jax.block_until_ready(step_fn(x))  # compile outside both timings
+    flops = 2.0 * 512 * 2048 * 2048 * matmuls
+
+    def run_loop(sl=None) -> float:
+        t0 = time.perf_counter()
+        for i in range(steps):
+            t1 = time.perf_counter()
+            jax.block_until_ready(step_fn(x))
+            if sl is not None:
+                sl.step(
+                    step=i + 1,
+                    loss=1.0,
+                    tokens=256,
+                    wall_s=time.perf_counter() - t1,
+                    flops=flops,
+                )
+        return steps / (time.perf_counter() - t0)
+
+    # bare best-of-2: the shared host's load varies; MAX is the honest
+    # denominator (same rule as the CPU baselines)
+    bare = max(run_loop() for _ in range(2))
+
+    import shutil
+
+    base = tempfile.mkdtemp(prefix="kst-obs-bench-")
+    out_dir = tempfile.mkdtemp(prefix="kst-obs-collector-")
+
+    class MetricsHandler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # noqa: D102 — quiet
+            pass
+
+        def do_GET(self):  # noqa: N802 — stdlib API
+            write_metrics_response(self)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), MetricsHandler)
+    mport = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    stop = threading.Event()
+    # 0.5 s cadence: 10x the production default, slow enough that the
+    # fsync'd federation publish isn't the workload (at 0.1 s it is)
+    collector = Collector(
+        out_dir,
+        targets=[f"http://127.0.0.1:{mport}/metrics"],
+        watch=[base],
+        interval_s=0.5,
+    )
+    thread = threading.Thread(
+        target=collector.run, args=(stop,), daemon=True
+    )
+    thread.start()
+    try:
+        with obs_events.run(base, pipeline="obs_overhead_bench"):
+            sl = obs_telemetry.active_step_log()
+            # warm the one-time telemetry imports (roofline pricing,
+            # health monitor) outside the timing, then best-of-2 — the
+            # same MAX rule the bare side and the CPU baselines use
+            sl.step(step=0, loss=1.0, tokens=256, wall_s=1e-3, flops=flops)
+            collected = max(run_loop(sl) for _ in range(2))
+        stop.set()
+        thread.join(timeout=10)
+        final = collector.cycle()  # drain what the loop wrote last,
+        # while the scrape endpoint is still up
+    finally:
+        stop.set()
+        httpd.shutdown()
+        httpd.server_close()
+    store_points = len(collector.store.query())
+    collector.close()
+    for path in (base, out_dir):
+        shutil.rmtree(path, ignore_errors=True)
+    return {
+        "steps": steps,
+        "bare_steps_per_s": round(bare, 2),
+        "collected_steps_per_s": round(collected, 2),
+        "overhead_pct": round((bare - collected) / bare * 100.0, 2),
+        "collector_cycles": collector.cycles,
+        "store_points": store_points,
+        "last_cycle": {
+            k: final.get(k)
+            for k in ("targets_ok", "targets_failed", "tailed_points")
+        },
+    }
+
+
 def bench_refit_latency(
     n_base: int | None = None,
     chunk_rows: int | None = None,
@@ -1900,6 +2019,16 @@ def main(argv: list[str] | None = None) -> int | None:
         result["autotune"] = bench_autotune()
     except Exception as e:  # noqa: BLE001 — same contract as above
         result["autotune"] = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+    # fleet-observability overhead (observe/collector.py): the same
+    # jitted loop bare vs instrumented with a live collector scraping +
+    # tailing it — pins whole-system observability < 5% of throughput;
+    # pure host+jit work, runs on the CPU fallback too
+    try:
+        result["obs_overhead"] = bench_obs_overhead()
+    except Exception as e:  # noqa: BLE001 — same contract as above
+        result["obs_overhead"] = {
+            "error": f"{type(e).__name__}: {str(e)[:200]}"
+        }
     # fused streaming-fit record (plan/fused_fit.py): streamed-vs-
     # materialized fit delta + chosen Gram operator + rows/s — the
     # solver-MFU trajectory the next chip session reads, runs on the

@@ -101,8 +101,14 @@ def main(argv: list[str] | None = None) -> None:
             f" jax.distributed runtime before dispatch — run the same command"
             f" on every host; --observe DIR writes a structured per-node\n"
             f" event log there, rendered by `observe <dir>`, tailed live by\n"
-            f" `observe top <dir>`, and compared across runs by\n"
-            f" `observe diff <dirA> <dirB>`; `faults --list`\n"
+            f" `observe top <dir>` (a base dir tails EVERY run dir — the\n"
+            f" fleet view), and compared across runs by\n"
+            f" `observe diff <dirA> <dirB>`; `observe collect <out>` runs\n"
+            f" the fleet collector (scrapes every /metrics, tails run dirs,\n"
+            f" evaluates SLO burn rates), `observe slo <out>` renders its\n"
+            f" verdicts + exemplars, and `observe serve <out> --port N` is\n"
+            f" the live fleet dashboard with federation /metrics;\n"
+            f" `faults --list`\n"
             f" prints the KEYSTONE_FAULTS injection sites; `plan <model>`\n"
             f" prints the cost-based planner's chosen plan without executing\n"
             f" (`--learned` shows the KEYSTONE_PLAN_STORE record instead);\n"

@@ -20,6 +20,10 @@ docstring):
 - :mod:`.devices` — per-device HBM watermark sampling
 - :mod:`.tracing` — programmatic profiler trace windows
 - :mod:`.top` — the ``observe top`` terminal dashboard
+- :mod:`.timeseries` — the collector's segmented on-disk point store
+- :mod:`.collector` — the fleet collector daemon (``observe collect``)
+- :mod:`.slo` — multi-window burn-rate SLO engine (``observe slo``)
+- :mod:`.dashboard` — the live fleet dashboard (``observe serve``)
 
 ``events`` and ``metrics`` are stdlib-light and imported eagerly (the
 core pipeline hooks depend on them); ``instrument``/``cost``/``report``
@@ -44,6 +48,10 @@ _LAZY = {
     "devices": "keystone_tpu.observe.devices",
     "tracing": "keystone_tpu.observe.tracing",
     "top": "keystone_tpu.observe.top",
+    "timeseries": "keystone_tpu.observe.timeseries",
+    "collector": "keystone_tpu.observe.collector",
+    "slo": "keystone_tpu.observe.slo",
+    "dashboard": "keystone_tpu.observe.dashboard",
 }
 
 

@@ -1,0 +1,711 @@
+"""The fleet collector daemon: one pane over N processes.
+
+PR 12's fleet and the elastic multihost run many processes, each with
+its own ``/metrics`` endpoint and its own run dir of
+``steps``/``events``/``spans`` streams. This daemon aggregates them:
+
+- **scrape**: every interval, fetch each target's Prometheus ``/metrics``
+  exposition (targets are static, env-listed, or advertised live by the
+  fleet router's ``/healthz`` — a replica relaunched on a rolling
+  restart appears on the next cycle with no config change), parse it
+  (:func:`..metrics.parse_prometheus`), and append every sample to the
+  time-series store with an ``instance`` label;
+- **tail**: discover run dirs under the watched observe base dirs
+  (again: new dirs appear live) and incrementally ingest their streams —
+  request spans (``serve.request`` / ``fleet.forward``) become
+  :data:`..slo.REQUEST_SERIES` points carrying ``ok`` + the
+  trace/request-id **exemplar**, step rows become goodput points, alert
+  events become alert points;
+- **evaluate**: run the SLO engine (:mod:`.slo`) over the store and
+  persist firing/cleared transitions as :data:`..slo.ALERT_SERIES`
+  points (the engine itself emits the ``alert`` events);
+- **federate**: write ``federation.prom`` — the merged exposition of
+  every target's last-good scrape plus a per-target ``up`` gauge — for
+  external scrapers (served by ``observe serve``'s ``/metrics``).
+
+Failure contract (the ``collector.scrape_fail`` drill pins it): a
+target dying mid-scrape costs that target that cycle — a gap in its
+series and a ``collector_scrape_fail`` bump — never a collector crash
+and never a torn store segment. The last-good snapshot keeps serving
+federation with ``up 0``.
+
+``python -m keystone_tpu observe collect <out-dir> ...`` runs it; all
+cadence comes from ``KEYSTONE_COLLECTOR_*`` env knobs (README table).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.request
+from typing import Any, Callable
+
+from keystone_tpu.observe import events as _events
+from keystone_tpu.observe import metrics as _metrics
+from keystone_tpu.observe import slo as _slo
+from keystone_tpu.observe.timeseries import TimeSeriesStore
+from keystone_tpu.resilience import faults as _faults
+
+ENV_INTERVAL_S = "KEYSTONE_COLLECTOR_INTERVAL_S"
+ENV_TARGETS = "KEYSTONE_COLLECTOR_TARGETS"
+ENV_TIMEOUT_S = "KEYSTONE_COLLECTOR_TIMEOUT_S"
+
+FEDERATION_FILE = "federation.prom"
+TARGETS_FILE = "targets.json"
+
+#: span names ingested as request outcomes (the SLO request stream)
+REQUEST_SPANS = ("serve.request", "fleet.forward")
+
+
+def interval_from_env() -> float:
+    try:
+        v = float(os.environ.get(ENV_INTERVAL_S, "") or 5.0)
+        return v if v > 0 else 5.0
+    except ValueError:
+        return 5.0
+
+
+def timeout_from_env() -> float:
+    try:
+        v = float(os.environ.get(ENV_TIMEOUT_S, "") or 2.0)
+        return v if v > 0 else 2.0
+    except ValueError:
+        return 2.0
+
+
+def targets_from_env() -> list[str]:
+    raw = os.environ.get(ENV_TARGETS, "")
+    return [t.strip() for t in raw.split(",") if t.strip()]
+
+
+def default_transport(
+    url: str, timeout: float, as_json: bool = False
+) -> Any:
+    """Fetch one URL: exposition text by default, parsed JSON bodies for
+    the discovery endpoints. Injectable on :class:`Collector` so the
+    unit tests run with zero sockets."""
+    headers = {"Accept": "application/json"} if as_json else {}
+    req = urllib.request.Request(url, headers=headers)
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        body = resp.read().decode("utf-8", "replace")
+    return json.loads(body) if as_json else body
+
+
+def _instance_of(url: str) -> str:
+    """``http://host:port/path`` → ``host:port`` (the instance label)."""
+    rest = url.split("://", 1)[-1]
+    return rest.split("/", 1)[0] or url
+
+
+class _Cursor:
+    """Incremental JSONL reader returning only records appended since
+    the previous poll. On first attach it reads the rotated ``.1``
+    generation first (a capped stream's oldest records live there).
+
+    Rotation mid-watch (:class:`..events.JsonlSink` renames the file to
+    ``.1`` and starts fresh) is detected by INODE, not size — a
+    same-size successor would fool a size check, and a bigger one would
+    silently resume at a bogus byte offset. On rotation the unread TAIL
+    of the old generation is recovered from ``.1`` before the new file
+    is read from the top, so no record is lost (the SLO engine's
+    availability math counts every request outcome, including the
+    failures a writer emits right before rotating)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.offset = 0
+        self._ino: int | None = None
+        self._first = True
+
+    @staticmethod
+    def _parse(chunk: bytes, out: list[dict]) -> int:
+        """Parse the complete lines of ``chunk`` into ``out``; returns
+        how many bytes were consumed (up to the final newline)."""
+        end = chunk.rfind(b"\n")
+        if end < 0:
+            return 0
+        for raw in chunk[: end + 1].splitlines():
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                out.append(json.loads(raw))
+            except ValueError:
+                continue
+        return end + 1
+
+    def poll(self) -> list[dict]:
+        out: list[dict] = []
+        if self._first:
+            self._first = False
+            rotated = self.path + ".1"
+            if os.path.isfile(rotated):
+                out.extend(_events.read_jsonl(rotated))
+        try:
+            st = os.stat(self.path)
+        except OSError:
+            return out
+        if self._ino is not None and st.st_ino != self._ino:
+            # rotated underneath us: the old generation is now `.1` —
+            # drain its unread tail before starting on the new file
+            try:
+                with open(self.path + ".1", "rb") as f:
+                    f.seek(self.offset)
+                    self._parse(f.read(), out)
+            except OSError:
+                pass  # second rotation raced us: that tail is gone
+            self.offset = 0
+        elif st.st_size < self.offset:
+            # same inode, shrunk: a genuine truncation — start over
+            self.offset = 0
+        self._ino = st.st_ino
+        if st.st_size == self.offset:
+            return out
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(self.offset)
+                chunk = f.read()
+        except OSError:
+            return out
+        self.offset += self._parse(chunk, out)
+        return out
+
+
+class Collector:
+    """The aggregation daemon. Everything time-driven takes the
+    injected ``clock`` and every cycle stage is callable on its own
+    (:meth:`scrape_once` / :meth:`tail_once` / :meth:`evaluate_slo`), so
+    the tests drive whole scrape→store→alert scenarios with zero
+    sleeps and zero sockets."""
+
+    def __init__(
+        self,
+        out_dir: str,
+        *,
+        targets: list[str] | None = None,
+        router: str | None = None,
+        watch: list[str] | None = None,
+        interval_s: float | None = None,
+        slo_config: _slo.SLOConfig | None = None,
+        clock: Callable[[], float] = time.time,
+        transport: Callable[..., Any] = default_transport,
+    ):
+        self.out_dir = out_dir
+        os.makedirs(out_dir, exist_ok=True)
+        self.store = TimeSeriesStore(
+            os.path.join(out_dir, "tsdb"), clock=clock
+        )
+        self.targets = list(targets or []) or targets_from_env()
+        self.router = router
+        self.watch = list(watch or [])
+        self.interval_s = (
+            interval_from_env() if interval_s is None else float(interval_s)
+        )
+        self.clock = clock
+        self.transport = transport
+        self.timeout_s = timeout_from_env()
+        self.engine = _slo.SLOEngine(self.store, slo_config, clock=clock)
+        self._scrapes: dict[str, dict] = {}  # target → last scrape state
+        self._router_targets: list[str] = []  # last-advertised replica set
+        self._cursors: dict[str, _Cursor] = {}
+        self._scrape_attempts = 0  # the collector.scrape_fail fault key
+        self.cycles = 0
+        # retention is only real if somebody RUNS compaction: the daemon
+        # does, ~24 times per retention window (hourly at the 24h
+        # default), so a long-lived collector's disk stays bounded
+        self.compact_every_s = max(60.0, self.store.retention_s / 24.0)
+        self._last_compact = clock()
+        reg = _metrics.get_registry()
+        reg.describe(
+            "collector_scrape_fail",
+            "scrapes that failed (target down or collector.scrape_fail "
+            "drill) — each one is a gap in that target's series",
+        )
+        reg.describe(
+            "collector_points", "points appended to the time-series store"
+        )
+
+    # ---------------------------------------------------------- discovery
+
+    def discover_targets(self) -> list[str]:
+        """Static targets plus whatever the fleet router currently
+        advertises (``/healthz`` → ``scrape_targets``) — the live set,
+        re-read every cycle so replicas relaunched on new incarnations
+        show up without a collector restart."""
+        out = list(self.targets)
+        if self.router:
+            base = self.router.rstrip("/")
+            try:
+                payload = self.transport(
+                    base + "/healthz", self.timeout_s, True
+                )
+                self._router_targets = [
+                    str(t) for t in payload.get("scrape_targets") or []
+                ]
+                out.extend(self._router_targets)
+                out.append(base + "/metrics")
+            except Exception as e:  # noqa: BLE001 — router down ≠ crash
+                _metrics.get_registry().counter(
+                    "collector_discover_fail"
+                ).inc()
+                self._note_router_error(e)
+                # ONE router blip (rolling restart, slow /healthz) must
+                # not flip every healthy replica to up=0 unscraped: keep
+                # scraping the last-advertised set — replicas that
+                # really died fail their own scrapes, which is the
+                # honest per-target signal
+                out.extend(self._router_targets)
+                out.append(base + "/metrics")
+        seen: set[str] = set()
+        uniq = []
+        for t in out:
+            if t not in seen:
+                seen.add(t)
+                uniq.append(t)
+        return uniq
+
+    def _note_router_error(self, e: Exception) -> None:
+        from keystone_tpu.core.logging import get_logger
+
+        get_logger("keystone_tpu.observe").warning(
+            "collector: router discovery at %s failed (%r)", self.router, e
+        )
+
+    def discover_run_dirs(self) -> list[str]:
+        """Run directories under every watched base (or the base itself
+        when it IS a run dir) — rescanned each cycle, so a replica that
+        booted after the collector did is tailed from its first record
+        (its rotated generation is read on attach)."""
+        out: list[str] = []
+        for base in self.watch:
+            if not os.path.isdir(base):
+                continue
+            if self._is_run_dir(base):
+                out.append(base)
+                continue
+            for name in sorted(os.listdir(base)):
+                path = os.path.join(base, name)
+                if os.path.isdir(path) and self._is_run_dir(path):
+                    out.append(path)
+        return out
+
+    @staticmethod
+    def _is_run_dir(path: str) -> bool:
+        return any(
+            os.path.isfile(os.path.join(path, f))
+            for f in ("events.jsonl", "steps.jsonl", "spans.jsonl")
+        )
+
+    # ------------------------------------------------------------- scrape
+
+    def scrape_once(self) -> dict:
+        """One scrape pass over the discovered targets. A failing
+        target is recorded (counter + last-error state + ``up 0`` in
+        federation) and skipped — the collector survives any replica
+        dying mid-scrape, by contract."""
+        ok = failed = points = 0
+        discovered = self.discover_targets()
+        # a target that VANISHED from discovery (router died, replica
+        # de-registered) is no longer scraped — its last-good snapshot
+        # must not keep advertising up=1 forever; flip it down so
+        # federation and the dashboard show the truth
+        for target, state in self._scrapes.items():
+            if target not in discovered and state.get("up"):
+                state["up"] = False
+                state["error"] = "target no longer discovered"
+        for target in discovered:
+            key = self._scrape_attempts
+            self._scrape_attempts += 1
+            instance = _instance_of(target)
+            try:
+                _faults.maybe_raise(
+                    "collector.scrape_fail", key, note=target
+                )
+                text = self.transport(target, self.timeout_s)
+                samples = _metrics.parse_prometheus(str(text))
+                n = self._ingest_samples(samples, instance)
+            except Exception as e:  # noqa: BLE001 — a dead replica is
+                # routine; the gap IS the record
+                failed += 1
+                _metrics.get_registry().counter(
+                    "collector_scrape_fail", target=instance
+                ).inc()
+                prev = self._scrapes.get(target) or {}
+                self._scrapes[target] = {
+                    **prev,
+                    "instance": instance,
+                    "ts": self.clock(),
+                    "up": False,
+                    "error": repr(e),
+                }
+                from keystone_tpu.resilience.emit import decision
+
+                decision(
+                    "collector_scrape_fail",
+                    target=instance,
+                    error=repr(e),
+                )
+                continue
+            ok += 1
+            points += n
+            self._scrapes[target] = {
+                "instance": instance,
+                "ts": self.clock(),
+                "up": True,
+                "samples": samples,
+                "points": n,
+            }
+        return {"targets_ok": ok, "targets_failed": failed, "points": points}
+
+    def _ingest_samples(
+        self, samples: list[_metrics.PromSample], instance: str
+    ) -> int:
+        now = self.clock()
+        n = 0
+        for s in samples:
+            series = _metrics._series_key(
+                s.name, {**s.labels, "instance": instance}
+            )
+            self.store.append(series, s.value, ts=now)
+            n += 1
+        if n:
+            _metrics.get_registry().counter("collector_points").inc(n)
+        return n
+
+    # --------------------------------------------------------------- tail
+
+    def tail_once(self) -> int:
+        """One incremental pass over every discovered run dir's
+        streams; returns the number of store points ingested."""
+        points = 0
+        for run_dir in self.discover_run_dirs():
+            for fname, handler in (
+                ("spans.jsonl", self._ingest_span),
+                ("steps.jsonl", self._ingest_step),
+                ("events.jsonl", self._ingest_event),
+            ):
+                path = os.path.join(run_dir, fname)
+                cur = self._cursors.get(path)
+                if cur is None:
+                    if not os.path.isfile(path):
+                        continue
+                    cur = self._cursors[path] = _Cursor(path)
+                for rec in cur.poll():
+                    points += handler(rec)
+        if points:
+            _metrics.get_registry().counter("collector_points").inc(points)
+        return points
+
+    def _ingest_span(self, rec: dict) -> int:
+        if rec.get("name") not in REQUEST_SPANS:
+            return 0
+        # one client request must be ONE availability sample: behind a
+        # fleet, every request yields a router fleet.forward AND a
+        # replica serve.request for the same outcome — counting both
+        # halves the measured error rate. A serve.request with a parent
+        # is the replica-side copy of a hop the router already counts;
+        # only parentless ones (direct-serve deployments) are samples.
+        if rec.get("name") == "serve.request" and rec.get("parent"):
+            return 0
+        self.store.append(
+            _slo.REQUEST_SERIES,
+            float(rec.get("wall_s") or 0.0),
+            ts=rec.get("ts"),
+            ok=rec.get("status") != "failed",
+            trace=rec.get("trace"),
+            rid=rec.get("rid"),
+            name=rec.get("name"),
+            run=rec.get("run"),
+        )
+        return 1
+
+    def _ingest_step(self, rec: dict) -> int:
+        n = 0
+        ts = rec.get("ts")
+        source = rec.get("source", "train")
+        rate = rec.get("tokens_per_s") or rec.get("rows_per_s")
+        if isinstance(rate, (int, float)):
+            self.store.append(
+                _slo.GOODPUT_SERIES,
+                float(rate),
+                ts=ts,
+                source=source,
+                run=rec.get("run"),
+            )
+            n += 1
+        if isinstance(rec.get("loss"), (int, float)):
+            self.store.append(
+                "train.loss", float(rec["loss"]), ts=ts, run=rec.get("run")
+            )
+            n += 1
+        if isinstance(rec.get("mfu"), (int, float)):
+            self.store.append(
+                "train.mfu", float(rec["mfu"]), ts=ts, run=rec.get("run")
+            )
+            n += 1
+        return n
+
+    def _ingest_event(self, rec: dict) -> int:
+        if rec.get("event") != "alert":
+            return 0
+        # per-process anomaly alerts (observe/health.py) land beside the
+        # SLO's own transitions so the dashboard lists one alert feed
+        self.store.append(
+            "alerts",
+            1.0,
+            ts=rec.get("ts"),
+            action=rec.get("action"),
+            run=rec.get("run"),
+        )
+        return 1
+
+    # ---------------------------------------------------------------- slo
+
+    def evaluate_slo(self) -> list[dict]:
+        """Run the burn-rate engine; persist every pair's short-window
+        burn as a ``slo_burn{objective=...,speed=...}`` gauge point (the
+        dashboard's burn timelines) and the firing/cleared transitions
+        as alert points (the engine already emitted the ``alert``
+        events)."""
+        verdicts = self.engine.evaluate()
+        for v in verdicts:
+            self.store.append(
+                _metrics._series_key(
+                    "slo_burn",
+                    {"objective": v["objective"], "speed": v["speed"]},
+                ),
+                v["burn_short"],
+                firing=bool(v["firing"]) or None,
+            )
+            if v["transition"] is None:
+                continue
+            self.store.append(
+                _slo.ALERT_SERIES,
+                1.0 if v["transition"] == "fired" else 0.0,
+                action=f"slo.{v['objective']}.{v['speed']}_burn",
+                state="firing" if v["transition"] == "fired" else "cleared",
+                burn_short=v["burn_short"],
+                burn_long=v["burn_long"],
+                exemplar_trace=v.get("exemplar_trace"),
+                exemplar_rid=v.get("exemplar_rid"),
+            )
+        return verdicts
+
+    # --------------------------------------------------------- federation
+
+    def write_federation(self) -> None:
+        """Atomically publish the merged exposition + target states for
+        external scrapers and the dashboard's ``/metrics``."""
+        from keystone_tpu.core.serialization import atomic_write
+
+        text = federation_text(self._scrapes)
+        try:
+            with atomic_write(os.path.join(self.out_dir, FEDERATION_FILE)) as f:
+                f.write(text.encode())
+            meta = {
+                t: {k: v for k, v in s.items() if k != "samples"}
+                for t, s in self._scrapes.items()
+            }
+            with atomic_write(os.path.join(self.out_dir, TARGETS_FILE)) as f:
+                f.write(json.dumps(meta, default=repr).encode())
+        except OSError as e:
+            from keystone_tpu.core.logging import get_logger
+
+            get_logger("keystone_tpu.observe").warning(
+                "collector: federation write failed (%r)", e
+            )
+
+    # -------------------------------------------------------------- cycle
+
+    def cycle(self) -> dict:
+        """One full collection cycle — scrape, tail, evaluate, federate
+        — with a ``collector`` event summarizing it when a sink is
+        active."""
+        scraped = self.scrape_once()
+        tailed = self.tail_once()
+        verdicts = self.evaluate_slo()
+        self.write_federation()
+        compacted = None
+        if self.clock() - self._last_compact >= self.compact_every_s:
+            self._last_compact = self.clock()
+            compacted = self.store.compact()
+        self.cycles += 1
+        firing = sum(1 for v in verdicts if v["firing"])
+        summary = {
+            **scraped,
+            "tailed_points": tailed,
+            "run_dirs": len(
+                {os.path.dirname(p) for p in self._cursors}
+            ),
+            "slo_firing": firing,
+            "cycle": self.cycles,
+        }
+        if compacted is not None:
+            summary["compacted"] = compacted
+        reg = _metrics.get_registry()
+        reg.gauge("collector_targets_up").set(scraped["targets_ok"])
+        reg.gauge("collector_slo_firing").set(firing)
+        log = _events.active()
+        if log is not None:
+            log.emit("collector", **summary)
+        return summary
+
+    def run(
+        self,
+        stop: threading.Event | None = None,
+        max_cycles: int | None = None,
+    ) -> None:
+        """The daemon loop: cycle then wait the interval; a ``stop``
+        event ends it promptly (the CLI's SIGTERM handler sets it)."""
+        stop = stop or threading.Event()
+        while not stop.is_set():
+            self.cycle()
+            if max_cycles is not None and self.cycles >= max_cycles:
+                return
+            stop.wait(self.interval_s)
+
+    def close(self) -> None:
+        self.store.close()
+
+
+def federation_text(scrapes: dict[str, dict]) -> str:
+    """Merge every target's last-good samples into one exposition body:
+    families keep their TYPE across instances, every sample gains the
+    target's ``instance`` label, and a synthetic ``up`` gauge per
+    target says which scrapes are current — the Prometheus federation
+    convention, so one external scraper ingests the whole tier."""
+    families: dict[str, tuple[str | None, list[str]]] = {}
+
+    def fam(name: str, kind: str | None) -> list[str]:
+        hit = families.get(name)
+        if hit is None:
+            hit = (kind, [])
+            families[name] = hit
+        return hit[1]
+
+    for target in sorted(scrapes):
+        state = scrapes[target]
+        instance = state.get("instance") or _instance_of(target)
+        fam("up", "gauge").append(
+            f'up{{instance="{instance}"}} {1 if state.get("up") else 0}'
+        )
+        for s in state.get("samples") or []:
+            labels = _metrics._prom_labels(
+                {**s.labels, "instance": instance}
+            )
+            # family key: quantile'd summary samples ride their bare
+            # name; _count/_sum ride theirs (TYPE declared on the family)
+            fam_name = s.name
+            for suffix in ("_count", "_sum"):
+                if s.kind == "summary" and s.name.endswith(suffix):
+                    fam_name = s.name[: -len(suffix)]
+            fam(fam_name, s.kind).append(
+                f"{s.name}{labels} {_metrics._prom_value(s.value)}"
+            )
+    lines: list[str] = []
+    for name in sorted(families):
+        kind, samples = families[name]
+        lines.append(
+            f"# HELP {name} federated by the keystone_tpu collector"
+        )
+        if kind:
+            lines.append(f"# TYPE {name} {kind}")
+        lines.extend(samples)
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+# --------------------------------------------------------------------- CLI
+
+
+USAGE = """usage: python -m keystone_tpu observe collect <out-dir> [options]
+options:
+  --targets URL,URL   static /metrics scrape targets
+                      (default KEYSTONE_COLLECTOR_TARGETS)
+  --router URL        fleet router base URL — its /healthz advertises the
+                      replicas' scrape targets, re-read every cycle
+  --watch DIR         observe base dir to tail run dirs under (repeatable;
+                      default KEYSTONE_OBSERVE_DIR)
+  --interval S        cycle cadence (default KEYSTONE_COLLECTOR_INTERVAL_S=5)
+  --slo FILE          declarative SLO config JSON (default env knobs)
+  --once              one cycle, print the summary, exit (tests/cron)
+"""
+
+
+def main(argv: list[str] | None = None) -> None:
+    import signal
+    import sys
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        raise SystemExit(USAGE)
+    out_dir = argv[0]
+    rest = argv[1:]
+    targets: list[str] = []
+    router = None
+    watch: list[str] = []
+    interval = None
+    slo_config = None
+    once = False
+    i = 0
+    while i < len(rest):
+        a = rest[i]
+        if a == "--once":
+            once = True
+            i += 1
+            continue
+        if a in ("--targets", "--router", "--watch", "--interval", "--slo"):
+            if i + 1 >= len(rest):
+                raise SystemExit(f"{a} needs a value")
+            val = rest[i + 1]
+            if a == "--targets":
+                targets.extend(t.strip() for t in val.split(",") if t.strip())
+            elif a == "--router":
+                router = val
+            elif a == "--watch":
+                watch.append(val)
+            elif a == "--interval":
+                try:
+                    interval = float(val)
+                except ValueError:
+                    raise SystemExit(f"--interval: bad seconds {val!r}") from None
+            elif a == "--slo":
+                slo_config = _slo.SLOConfig.from_file(val)
+            i += 2
+            continue
+        raise SystemExit(f"unknown option {a!r}\n{USAGE}")
+    if not watch:
+        base = os.environ.get(_events.ENV_DIR)
+        if base:
+            watch.append(base)
+    collector = Collector(
+        out_dir,
+        targets=targets,
+        router=router,
+        watch=watch,
+        interval_s=interval,
+        slo_config=slo_config,
+    )
+    if once:
+        summary = collector.cycle()
+        collector.close()
+        print(json.dumps(summary))
+        return
+    stop = threading.Event()
+
+    def _term(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    print(
+        f"collector: store {os.path.join(out_dir, 'tsdb')}  "
+        f"targets={len(targets)}{' +router' if router else ''}  "
+        f"watch={watch}  every {collector.interval_s:g}s",
+        flush=True,
+    )
+    try:
+        collector.run(stop)
+    finally:
+        collector.close()

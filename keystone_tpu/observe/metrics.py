@@ -17,7 +17,7 @@ import contextlib
 import random
 import threading
 import time
-from typing import Any, Iterable, Iterator, Sequence
+from typing import Any, Iterable, Iterator, NamedTuple, Sequence
 
 # label-value characters that collide with the key syntax itself — a
 # value like a node repr ("f{x}, y=2") must not alias another series
@@ -214,6 +214,20 @@ def _prom_name(name: str) -> str:
     return out
 
 
+def _prom_counter_name(name: str) -> str:
+    """Exposition name of a counter: the conformant ``_total`` suffix
+    (a scraper's counter-vs-gauge heuristics and recording rules key off
+    it), added once — a name already ending in ``_total`` stays put."""
+    pname = _prom_name(name)
+    return pname if pname.endswith("_total") else pname + "_total"
+
+
+def _prom_help(text: str) -> str:
+    """Escape a HELP string per the exposition rules (backslash and
+    newline only; quotes are legal in HELP)."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _prom_labels(labels: dict[str, Any]) -> str:
     if not labels:
         return ""
@@ -234,6 +248,96 @@ def _prom_value(v: Any) -> str:
     return repr(int(f)) if f.is_integer() and abs(f) < 2**53 else repr(f)
 
 
+class PromSample(NamedTuple):
+    """One parsed exposition sample: family kind rides along (None when
+    the text declared no TYPE for it)."""
+
+    name: str
+    kind: str | None
+    labels: dict[str, str]
+    value: float
+
+
+def _parse_prom_labels(inner: str) -> dict[str, str]:
+    """Parse ``a="x",b="y"`` with exposition escapes (``\\\\``, ``\\"``,
+    ``\\n``) inside the quoted values."""
+    labels: dict[str, str] = {}
+    i = 0
+    n = len(inner)
+    while i < n:
+        eq = inner.find("=", i)
+        if eq < 0:
+            break
+        key = inner[i:eq].strip().lstrip(",").strip()
+        i = eq + 1
+        if i >= n or inner[i] != '"':
+            break
+        i += 1
+        out: list[str] = []
+        while i < n:
+            c = inner[i]
+            if c == "\\" and i + 1 < n:
+                nxt = inner[i + 1]
+                out.append({"n": "\n"}.get(nxt, nxt))
+                i += 2
+                continue
+            if c == '"':
+                i += 1
+                break
+            out.append(c)
+            i += 1
+        labels[key] = "".join(out)
+    return labels
+
+
+def parse_prometheus(text: str) -> list[PromSample]:
+    """Parse Prometheus 0.0.4 text exposition into samples — the
+    collector's scrape decoder (and the conformance check that
+    :meth:`MetricsRegistry.to_prometheus` round-trips). ``# TYPE`` lines
+    attach the family kind to every sample of that family, including
+    summary ``_count``/``_sum`` suffixed lines; unparseable lines are
+    skipped (a scrape must degrade, not crash)."""
+    kinds: dict[str, str] = {}
+    samples: list[PromSample] = []
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                kinds[parts[2]] = parts[3].strip()
+            continue
+        if line.startswith("{"):
+            continue
+        brace = line.find("{")
+        if brace >= 0:
+            name = line[:brace]
+            close = line.rfind("}")
+            if close < brace:
+                continue
+            labels = _parse_prom_labels(line[brace + 1 : close])
+            rest = line[close + 1 :].strip()
+        else:
+            name, _, rest = line.partition(" ")
+            labels = {}
+            rest = rest.strip()
+        if not name or not rest:
+            continue
+        try:
+            value = float(rest.split()[0])
+        except ValueError:
+            continue
+        kind = kinds.get(name)
+        if kind is None:
+            for suffix in ("_count", "_sum"):
+                if name.endswith(suffix):
+                    kind = kinds.get(name[: -len(suffix)])
+                    break
+        samples.append(PromSample(name, kind, labels, value))
+    return samples
+
+
 class MetricsRegistry:
     """Get-or-create home of all labeled series in one process."""
 
@@ -242,6 +346,14 @@ class MetricsRegistry:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._series: dict[str, tuple[str, Any]] = {}
+        self._help: dict[str, str] = {}
+
+    def describe(self, name: str, help_text: str) -> None:
+        """Attach a ``# HELP`` string to a metric family (by its bare
+        name, pre-``_total``); families without one get an auto-generated
+        line so the exposition stays conformant either way."""
+        with self._lock:
+            self._help[name] = str(help_text)
 
     def _get(self, kind: str, name: str, labels: dict[str, Any]):
         key = _series_key(name, labels)
@@ -296,19 +408,31 @@ class MetricsRegistry:
 
     def to_prometheus(self) -> str:
         """Prometheus text exposition (format 0.0.4) of every series —
-        the ``/metrics`` scrape body. Counters and gauges map directly;
-        timers render as a summary family: ``<name>_count``,
-        ``<name>_sum`` (seconds), and reservoir-estimated
-        ``quantile="0.5|0.95|0.99"`` sample lines. Metric names are
-        sanitized to the Prometheus charset; label values escape
-        backslash, quote, and newline per the exposition rules."""
+        the ``/metrics`` scrape body. Conformance an external scraper
+        (and the collector's federation endpoint) relies on: every
+        family gets ``# HELP`` and ``# TYPE`` lines (auto-generated HELP
+        when :meth:`describe` never named one), counters expose under
+        the ``_total`` suffix, gauges map directly, and timers render as
+        a summary family: ``<name>_count``, ``<name>_sum`` (seconds),
+        and reservoir-estimated ``quantile="0.5|0.95|0.99"`` sample
+        lines. Metric names are sanitized to the Prometheus charset;
+        label values escape backslash, quote, and newline per the
+        exposition rules. The JSON negotiation path (:meth:`snapshot`)
+        is untouched — its keys stay the registry's bare series keys."""
         with self._lock:
             items = list(self._series.items())
+            help_texts = dict(self._help)
         families: dict[tuple[str, str], list[str]] = {}
+        bare_names: dict[tuple[str, str], str] = {}
         for key, (kind, series) in sorted(items):
             name, labels = parse_series_key(key)
-            pname = _prom_name(name)
+            pname = (
+                _prom_counter_name(name)
+                if kind == "counter"
+                else _prom_name(name)
+            )
             fam = families.setdefault((pname, kind), [])
+            bare_names[(pname, kind)] = name
             if kind == "timer":
                 summ = series.summary()
                 fam.append(
@@ -332,7 +456,17 @@ class MetricsRegistry:
                 )
         lines: list[str] = []
         type_names = {"counter": "counter", "gauge": "gauge", "timer": "summary"}
+        kind_help = {
+            "counter": "monotonic count",
+            "gauge": "last-written value",
+            "timer": "duration summary (seconds)",
+        }
         for (pname, kind), fam in families.items():
+            bare = bare_names[(pname, kind)]
+            help_text = help_texts.get(bare) or (
+                f"keystone_tpu {kind_help[kind]} '{bare}'"
+            )
+            lines.append(f"# HELP {pname} {_prom_help(help_text)}")
             lines.append(f"# TYPE {pname} {type_names[kind]}")
             lines.extend(fam)
         return "\n".join(lines) + ("\n" if lines else "")

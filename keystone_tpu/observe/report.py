@@ -51,6 +51,7 @@ def summarize(events: list[dict]) -> dict[str, Any]:
     swaps: list[dict] = []
     refits: list[dict] = []
     tunes: list[dict] = []
+    collectors: list[dict] = []
     alerts: list[dict] = []
     device_memory: dict | None = None
     trace_windows: list[dict] = []
@@ -96,6 +97,8 @@ def summarize(events: list[dict]) -> dict[str, Any]:
             refits.append(ev)
         elif kind == "tune":
             tunes.append(ev)
+        elif kind == "collector":
+            collectors.append(ev)
         elif kind == "alert":
             alerts.append(ev)
         elif kind == "device_memory":
@@ -118,6 +121,7 @@ def summarize(events: list[dict]) -> dict[str, Any]:
         "model_swaps": swaps,
         "refits": refits,
         "tunes": tunes,
+        "collectors": collectors,
         "alerts": alerts,
         "device_memory": device_memory,
         "trace_windows": trace_windows,
@@ -299,6 +303,7 @@ def render(run_dir: str) -> str:
                 lines.append(f"  {ev.get('action', '?')}: {fields}")
             lines.append("")
     lines.extend(_tune_section(summary))
+    lines.extend(_collector_section(summary))
     lines.extend(_alert_section(run_dir, summary))
     lines.extend(_goodput_section(run_dir))
     lines.extend(_telemetry_sections(run_dir, summary))
@@ -341,6 +346,29 @@ def _tune_section(summary: dict) -> list[str]:
             and v is not None
         )
         lines.append(f"  {ev.get('action', '?')}: {fields}")
+    lines.append("")
+    return lines
+
+
+def _collector_section(summary: dict) -> list[str]:
+    """The fleet-collector lifecycle: cycle count, last cycle's scrape
+    outcome, and how many SLO pairs were firing at the end."""
+    cycles = summary.get("collectors") or []
+    if not cycles:
+        return []
+    last = cycles[-1]
+    lines = ["collector:"]
+    lines.append(
+        f"  {len(cycles)} cycle(s); last: "
+        f"{last.get('targets_ok', 0)} target(s) ok, "
+        f"{last.get('targets_failed', 0)} failed, "
+        f"{last.get('points', 0)} scraped point(s), "
+        f"{last.get('tailed_points', 0)} tailed, "
+        f"{last.get('run_dirs', 0)} run dir(s)"
+    )
+    firing = last.get("slo_firing")
+    if firing:
+        lines.append(f"  SLO: {firing} (objective, window) pair(s) FIRING")
     lines.append("")
     return lines
 
@@ -841,6 +869,21 @@ def main(argv: list[str] | None = None) -> None:
         from keystone_tpu.observe import spans as _spans
 
         return _spans.main(argv[1:])
+    if argv and argv[0] == "collect":
+        # the fleet collector daemon: scrape + tail → time-series store
+        from keystone_tpu.observe import collector as _collector
+
+        return _collector.main(argv[1:])
+    if argv and argv[0] == "slo":
+        # burn-rate status over a collector store: `observe slo <dir>`
+        from keystone_tpu.observe import slo as _slo
+
+        return _slo.main(argv[1:])
+    if argv and argv[0] == "serve":
+        # the live fleet dashboard: `observe serve <dir> --port N`
+        from keystone_tpu.observe import dashboard as _dashboard
+
+        return _dashboard.main(argv[1:])
     if not argv or argv[0] in ("-h", "--help"):
         raise SystemExit(
             "usage: python -m keystone_tpu observe <run-dir>\n"
@@ -849,13 +892,24 @@ def main(argv: list[str] | None = None) -> None:
             "       python -m keystone_tpu observe trace <run-dir>"
             " [--request ID] [--limit N]\n"
             "       python -m keystone_tpu observe diff <dirA> <dirB>\n"
+            "       python -m keystone_tpu observe collect <out-dir>"
+            " [--router URL] [--watch DIR] [--once]\n"
+            "       python -m keystone_tpu observe slo <out-dir>"
+            " [--config FILE]\n"
+            "       python -m keystone_tpu observe serve <out-dir>"
+            " [--port N]\n"
             "<run-dir> is a directory containing events.jsonl, or a base\n"
-            "KEYSTONE_OBSERVE_DIR (the newest run under it is rendered);\n"
-            "`top` tails steps.jsonl/events.jsonl as a live dashboard;\n"
+            "KEYSTONE_OBSERVE_DIR (the newest run under it is rendered;\n"
+            "`top` on a base dir tails EVERY run dir, live);\n"
             "`trace` renders spans.jsonl as per-trace span trees with a\n"
             "critical-path summary and the goodput bucket breakdown;\n"
             "`diff` renders side-by-side goodput shares, step-time\n"
-            "percentiles, and event-counter deltas between two runs"
+            "percentiles, and event-counter deltas between two runs;\n"
+            "`collect` runs the fleet collector (scrapes /metrics,\n"
+            "tails run dirs, evaluates SLOs into <out-dir>/tsdb);\n"
+            "`slo` renders burn-rate status + alert history over a\n"
+            "collector store; `serve` is the live fleet dashboard with\n"
+            "/api/query range queries and federation /metrics"
         )
     try:
         print(render(argv[0]))

@@ -43,7 +43,8 @@ EVENT_KINDS: dict[str, str] = {
     "(parallel/multihost.py)",
     "alert": "an anomaly-monitor verdict: step-time drift, loss spike, "
     "HBM growth, deadline miss / shed rate, feature drift "
-    "(observe/health.py)",
+    "(observe/health.py); SLO burn-rate firing/cleared transitions "
+    "with trace exemplars (observe/slo.py, phase=slo)",
     "model_swap": "online-learning model lifecycle: hot-swap with "
     "old/new version ids, rollback of a failed candidate, shadow "
     "start/stop (learn/swap.py, serve/server.py)",
@@ -51,6 +52,10 @@ EVENT_KINDS: dict[str, str] = {
     "model published, reload notify (learn/refit.py)",
     "tune": "an autotuner decision: knob adjust/commit/revert/hold/load "
     "with the current knob snapshot and window goodput (plan/tune.py)",
+    "collector": "a fleet-collector cycle summary: targets scraped/"
+    "failed, points ingested, run dirs tailed, SLO verdicts firing "
+    "(observe/collector.py); SLO burn-rate transitions ride the "
+    "'alert' kind with phase=slo (observe/slo.py)",
 }
 
 _warned: set[str] = set()

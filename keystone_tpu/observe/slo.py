@@ -1,0 +1,531 @@
+"""Declarative SLOs with multi-window, multi-burn-rate alerting.
+
+The fleet's health question is not "did a request fail" (the anomaly
+monitor answers that per process) but "is the error budget burning fast
+enough that a human must act before it is gone" — the SRE burn-rate
+formulation. This module evaluates it over the collector's time-series
+store (:mod:`.timeseries`):
+
+- an **objective** declares what fraction of outcomes must be good
+  (availability: requests that didn't fail; latency: requests under a
+  threshold; goodput: throughput samples above a floor),
+- a **burn rate** is the window's bad fraction divided by the error
+  budget (``1 - target``) — burn 1.0 spends the budget exactly at its
+  sustainable rate,
+- an alert **fires** only when BOTH a short and a long window exceed
+  the speed's factor (fast: 5m-over-1h at 14.4x, slow: 1h-over-6h at
+  6x by default) — the long window keeps a blip from paging, the short
+  window makes recovery reset the alert promptly,
+- every firing alert carries a **trace exemplar** — the trace/request
+  id of a concrete offending request in the short window — so
+  ``observe trace <dir> --request <rid>`` jumps straight from the page
+  to the causal span tree.
+
+Verdicts are pure functions of (store contents, injected clock), so the
+tests drive fast-fires / slow-holds / recovery-clears with zero sleeps.
+Transitions (fired → cleared) emit one ``alert`` event each through the
+resilience emit schema — the same stream ``observe top`` and the run
+report already render.
+
+Config: :func:`SLOConfig.default` builds from ``KEYSTONE_SLO_*`` env
+knobs; :func:`SLOConfig.from_file` reads a declarative JSON file (see
+the README's example) with env knobs still applied on top.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Any, Callable
+
+from keystone_tpu.observe.timeseries import TimeSeriesStore
+
+#: store series the collector ingests request outcomes into (one point
+#: per serve.request / fleet.forward span: value = wall seconds, attrs
+#: ok/trace/rid)
+REQUEST_SERIES = "slo.requests"
+#: throughput samples (tokens_per_s / rows_per_s from tailed step rows)
+GOODPUT_SERIES = "slo.goodput"
+#: SLO alert transitions persisted by the collector (value 1 = fired,
+#: 0 = cleared) — what ``observe slo`` and the dashboard list as history
+ALERT_SERIES = "slo.alert"
+
+ENV_CONFIG = "KEYSTONE_SLO_CONFIG"
+ENV_AVAILABILITY = "KEYSTONE_SLO_AVAILABILITY"
+ENV_LATENCY_MS = "KEYSTONE_SLO_LATENCY_MS"
+ENV_LATENCY_TARGET = "KEYSTONE_SLO_LATENCY_TARGET"
+ENV_GOODPUT_FLOOR = "KEYSTONE_SLO_GOODPUT_FLOOR"
+ENV_GOODPUT_TARGET = "KEYSTONE_SLO_GOODPUT_TARGET"
+ENV_FAST_FACTOR = "KEYSTONE_SLO_FAST_FACTOR"
+ENV_SLOW_FACTOR = "KEYSTONE_SLO_SLOW_FACTOR"
+ENV_WINDOW_SCALE = "KEYSTONE_SLO_WINDOW_SCALE"
+ENV_MIN_POINTS = "KEYSTONE_SLO_MIN_POINTS"
+
+
+def _env_float(name: str) -> float | None:
+    raw = os.environ.get(name, "").strip()
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    return None
+
+
+@dataclasses.dataclass(frozen=True)
+class BurnWindow:
+    """One alerting speed: short window (prompt detection + prompt
+    recovery) gated by a long window (blip suppression)."""
+
+    name: str
+    short_s: float
+    long_s: float
+    factor: float
+
+
+# the classic SRE pair: fast pages on 14.4x burn over 5m-and-1h (2% of
+# a 30-day budget gone in an hour), slow tickets on 6x over 1h-and-6h
+DEFAULT_FAST = BurnWindow("fast", 300.0, 3600.0, 14.4)
+DEFAULT_SLOW = BurnWindow("slow", 3600.0, 21600.0, 6.0)
+
+
+@dataclasses.dataclass
+class Objective:
+    """One declarative objective over one store series."""
+
+    name: str
+    kind: str  # "availability" | "latency" | "goodput"
+    target: float = 0.999  # required good fraction
+    threshold_s: float | None = None  # latency: bad above this wall
+    floor: float | None = None  # goodput: bad below this rate
+    series: str = ""
+    min_points: int = 6  # short-window sample floor before verdicts arm
+
+    def __post_init__(self) -> None:
+        if not self.series:
+            self.series = (
+                GOODPUT_SERIES if self.kind == "goodput" else REQUEST_SERIES
+            )
+        if self.kind not in ("availability", "latency", "goodput"):
+            raise ValueError(
+                f"objective {self.name!r}: unknown kind {self.kind!r}"
+            )
+        if self.kind == "latency" and self.threshold_s is None:
+            raise ValueError(
+                f"latency objective {self.name!r} needs threshold_s"
+            )
+        if self.kind == "goodput" and self.floor is None:
+            raise ValueError(f"goodput objective {self.name!r} needs floor")
+
+    def budget(self) -> float:
+        return max(1.0 - float(self.target), 1e-9)
+
+    def is_good(self, point: dict) -> bool:
+        """Classify one store point. A failed request is bad for the
+        latency objective too — a request that errored never met its
+        latency promise."""
+        if self.kind == "availability":
+            return bool(point.get("ok", True))
+        if self.kind == "latency":
+            if not point.get("ok", True):
+                return False
+            return float(point.get("value") or 0.0) <= self.threshold_s
+        return float(point.get("value") or 0.0) >= self.floor
+
+    def exemplar_of(self, bad_points: list[dict]) -> dict | None:
+        """The one offending point an alert should link to: the slowest
+        bad request for latency, the lowest sample for goodput, the
+        newest failure for availability (the freshest lead)."""
+        if not bad_points:
+            return None
+        if self.kind == "latency":
+            return max(bad_points, key=lambda p: float(p.get("value") or 0.0))
+        if self.kind == "goodput":
+            return min(bad_points, key=lambda p: float(p.get("value") or 0.0))
+        return bad_points[-1]
+
+
+def _apply_min_points(objectives: list[Objective]) -> list[Objective]:
+    """``KEYSTONE_SLO_MIN_POINTS`` overrides every objective's arming
+    floor — the low-traffic-tier knob (6-sample windows paging a quiet
+    fleet is noise, not signal)."""
+    mp = _env_float(ENV_MIN_POINTS)
+    if mp is not None:
+        for o in objectives:
+            o.min_points = max(int(mp), 1)
+    return objectives
+
+
+def default_objectives() -> list[Objective]:
+    """The env-driven objective set: availability + latency always,
+    goodput floor only when ``KEYSTONE_SLO_GOODPUT_FLOOR`` names one."""
+    out = [
+        Objective(
+            "availability",
+            "availability",
+            target=_env_float(ENV_AVAILABILITY) or 0.999,
+        ),
+        Objective(
+            "latency",
+            "latency",
+            target=_env_float(ENV_LATENCY_TARGET) or 0.95,
+            threshold_s=(_env_float(ENV_LATENCY_MS) or 500.0) / 1e3,
+        ),
+    ]
+    floor = _env_float(ENV_GOODPUT_FLOOR)
+    if floor is not None:
+        out.append(
+            Objective(
+                "goodput",
+                "goodput",
+                target=_env_float(ENV_GOODPUT_TARGET) or 0.9,
+                floor=floor,
+            )
+        )
+    return _apply_min_points(out)
+
+
+@dataclasses.dataclass
+class SLOConfig:
+    objectives: list[Objective]
+    windows: list[BurnWindow]
+
+    @classmethod
+    def default(cls) -> "SLOConfig":
+        """Env-knob config (``KEYSTONE_SLO_*``); honors a declarative
+        file named by ``KEYSTONE_SLO_CONFIG`` first."""
+        path = os.environ.get(ENV_CONFIG, "").strip()
+        if path:
+            return cls.from_file(path)
+        return cls(default_objectives(), _windows_from_env())
+
+    @classmethod
+    def from_file(cls, path: str) -> "SLOConfig":
+        """Declarative JSON config::
+
+            {"objectives": [
+                {"name": "availability", "kind": "availability",
+                 "target": 0.999},
+                {"name": "latency", "kind": "latency",
+                 "target": 0.95, "threshold_ms": 250},
+                {"name": "goodput", "kind": "goodput",
+                 "target": 0.9, "floor": 1000.0}],
+             "fast": {"short_s": 300, "long_s": 3600, "factor": 14.4},
+             "slow": {"short_s": 3600, "long_s": 21600, "factor": 6.0}}
+
+        ``KEYSTONE_SLO_FAST_FACTOR`` / ``_SLOW_FACTOR`` /
+        ``_WINDOW_SCALE`` / ``_MIN_POINTS`` still apply on top, so one
+        ops override never requires editing the committed file."""
+        with open(path) as f:
+            raw = json.load(f)
+        objectives: list[Objective] = []
+        for spec in raw.get("objectives") or []:
+            spec = dict(spec)
+            if "threshold_ms" in spec:
+                spec["threshold_s"] = float(spec.pop("threshold_ms")) / 1e3
+            objectives.append(
+                Objective(
+                    name=str(spec.get("name") or spec.get("kind")),
+                    kind=str(spec.get("kind")),
+                    target=float(spec.get("target", 0.999)),
+                    threshold_s=spec.get("threshold_s"),
+                    floor=spec.get("floor"),
+                    series=str(spec.get("series") or ""),
+                    min_points=int(spec.get("min_points", 6)),
+                )
+            )
+        if not objectives:
+            objectives = default_objectives()
+        else:
+            _apply_min_points(objectives)
+        windows = _windows_from_env(
+            fast=_window_from(raw.get("fast"), DEFAULT_FAST),
+            slow=_window_from(raw.get("slow"), DEFAULT_SLOW),
+        )
+        return cls(objectives, windows)
+
+
+def _window_from(spec: dict | None, base: BurnWindow) -> BurnWindow:
+    if not spec:
+        return base
+    return BurnWindow(
+        base.name,
+        float(spec.get("short_s", base.short_s)),
+        float(spec.get("long_s", base.long_s)),
+        float(spec.get("factor", base.factor)),
+    )
+
+
+def _windows_from_env(
+    fast: BurnWindow = DEFAULT_FAST, slow: BurnWindow = DEFAULT_SLOW
+) -> list[BurnWindow]:
+    scale = _env_float(ENV_WINDOW_SCALE) or 1.0
+    fast_factor = _env_float(ENV_FAST_FACTOR) or fast.factor
+    slow_factor = _env_float(ENV_SLOW_FACTOR) or slow.factor
+    return [
+        BurnWindow("fast", fast.short_s * scale, fast.long_s * scale, fast_factor),
+        BurnWindow("slow", slow.short_s * scale, slow.long_s * scale, slow_factor),
+    ]
+
+
+class SLOEngine:
+    """Evaluates every (objective, speed) pair against the store and
+    emits ``alert`` events on firing/cleared TRANSITIONS only — a burn
+    that stays high across evaluations pages once, and recovery says so
+    exactly once.
+
+    ``emit=False`` collects verdicts without events/counters — the
+    read-only form the ``observe slo`` CLI and the dashboard use against
+    a store some other process's collector owns.
+    """
+
+    def __init__(
+        self,
+        store: TimeSeriesStore,
+        config: SLOConfig | None = None,
+        *,
+        clock: Callable[[], float] = time.time,
+        emit: bool = True,
+    ):
+        self.store = store
+        self.config = config or SLOConfig.default()
+        self.clock = clock
+        self.emit = emit
+        self.alerts: list[dict] = []  # transition history, oldest first
+        self._firing: set[tuple[str, str]] = set()
+
+    # ---------------------------------------------------------- verdicts
+
+    def _burn(
+        self, obj: Objective, points: list[dict], start: float, end: float
+    ) -> dict:
+        """Burn rate of one window over pre-fetched points (one store
+        query per objective covers every window of both speeds)."""
+        good = bad = 0
+        bad_points: list[dict] = []
+        for p in points:
+            ts = p.get("ts")
+            if not isinstance(ts, (int, float)) or ts < start or ts > end:
+                continue
+            if obj.is_good(p):
+                good += 1
+            else:
+                bad += 1
+                bad_points.append(p)
+        total = good + bad
+        rate = bad / total if total else 0.0
+        return {
+            "burn": rate / obj.budget(),
+            "rate": rate,
+            "total": total,
+            "bad": bad,
+            "exemplar": obj.exemplar_of(bad_points),
+        }
+
+    def evaluate(self, now: float | None = None) -> list[dict]:
+        """One evaluation pass: a verdict per (objective, speed), with
+        ``transition`` set on the passes where the state flipped."""
+        now = self.clock() if now is None else float(now)
+        verdicts: list[dict] = []
+        max_window = max(
+            (w.long_s for w in self.config.windows), default=0.0
+        )
+        # one disk read per SERIES, not per objective: availability and
+        # latency both consume slo.requests over the same range
+        points_by_series: dict[str, list[dict]] = {}
+        for obj in self.config.objectives:
+            points = points_by_series.get(obj.series)
+            if points is None:
+                points = points_by_series[obj.series] = self.store.query(
+                    obj.series, start=now - max_window, end=now
+                )
+            for w in self.config.windows:
+                short = self._burn(obj, points, now - w.short_s, now)
+                long = self._burn(obj, points, now - w.long_s, now)
+                firing = (
+                    short["total"] >= obj.min_points
+                    and short["burn"] > w.factor
+                    and long["burn"] > w.factor
+                )
+                exemplar = short["exemplar"] or long["exemplar"] or {}
+                verdict: dict[str, Any] = {
+                    "objective": obj.name,
+                    "kind": obj.kind,
+                    "speed": w.name,
+                    "factor": w.factor,
+                    "short_s": w.short_s,
+                    "long_s": w.long_s,
+                    "burn_short": round(short["burn"], 4),
+                    "burn_long": round(long["burn"], 4),
+                    "error_rate": round(short["rate"], 4),
+                    "total": short["total"],
+                    "bad": short["bad"],
+                    "target": obj.target,
+                    "firing": firing,
+                    "transition": None,
+                }
+                if exemplar:
+                    if exemplar.get("trace"):
+                        verdict["exemplar_trace"] = exemplar["trace"]
+                    if exemplar.get("rid") is not None:
+                        verdict["exemplar_rid"] = exemplar["rid"]
+                key = (obj.name, w.name)
+                if firing and key not in self._firing:
+                    self._firing.add(key)
+                    verdict["transition"] = "fired"
+                    self._transition(verdict, "firing", now)
+                elif not firing and key in self._firing:
+                    self._firing.discard(key)
+                    verdict["transition"] = "cleared"
+                    self._transition(verdict, "cleared", now)
+                verdicts.append(verdict)
+        return verdicts
+
+    def _transition(self, verdict: dict, state: str, now: float) -> None:
+        action = f"slo.{verdict['objective']}.{verdict['speed']}_burn"
+        rec = {"ts": now, "action": action, "state": state, **verdict}
+        self.alerts.append(rec)
+        if not self.emit:
+            return
+        from keystone_tpu.resilience.emit import decision
+
+        detail = {
+            k: verdict[k]
+            for k in (
+                "burn_short",
+                "burn_long",
+                "factor",
+                "short_s",
+                "long_s",
+                "error_rate",
+                "total",
+                "bad",
+                "target",
+                "exemplar_trace",
+                "exemplar_rid",
+            )
+            if verdict.get(k) is not None
+        }
+        decision(
+            action,
+            counter="alerts",
+            counter_labels={"kind": action},
+            event_kind="alert",
+            phase="slo",
+            state=state,
+            objective=verdict["objective"],
+            speed=verdict["speed"],
+            **detail,
+        )
+
+
+# --------------------------------------------------------------- rendering
+
+
+def resolve_store_dir(path: str) -> str:
+    """Accept the collector's out dir (contains ``tsdb/``) or the tsdb
+    directory itself."""
+    sub = os.path.join(path, "tsdb")
+    if os.path.isdir(sub):
+        return sub
+    if os.path.isdir(path):
+        return path
+    raise FileNotFoundError(f"no time-series store under {path!r}")
+
+
+def render_status(
+    store: TimeSeriesStore,
+    config: SLOConfig | None = None,
+    now: float | None = None,
+) -> str:
+    """The ``observe slo`` body: one line per (objective, speed) with
+    burn rates vs factor, FIRING markers with their exemplar ids, and
+    the collector-persisted alert history."""
+    engine = SLOEngine(store, config, emit=False)
+    verdicts = engine.evaluate(now)
+    lines: list[str] = []
+    lines.append(
+        f"slo status  [{store.dir}]  "
+        f"objectives={len(engine.config.objectives)}  "
+        f"windows={'/'.join(w.name for w in engine.config.windows)}"
+    )
+    lines.append("")
+    header = (
+        f"{'objective':14} {'speed':5} {'burn(short)':>11} "
+        f"{'burn(long)':>10} {'factor':>7} {'n':>6} {'bad':>5}  status"
+    )
+    lines.append(header)
+    lines.append("-" * len(header))
+    for v in verdicts:
+        status = "FIRING" if v["firing"] else "ok"
+        if v["firing"] and v.get("exemplar_rid") is not None:
+            status += f"  exemplar rid={v['exemplar_rid']}"
+        if v["firing"] and v.get("exemplar_trace"):
+            status += f" trace={v['exemplar_trace']}"
+        lines.append(
+            f"{v['objective']:14} {v['speed']:5} {v['burn_short']:>11.2f} "
+            f"{v['burn_long']:>10.2f} {v['factor']:>7.1f} "
+            f"{v['total']:>6} {v['bad']:>5}  {status}"
+        )
+    # both history and the count below are bounded to the slow window
+    # so the segment-span cache prunes old segments — a status command
+    # must not re-parse a day of retention
+    horizon = max(w.long_s for w in engine.config.windows)
+    t_now = time.time() if now is None else now
+    history = store.query(
+        ALERT_SERIES, start=t_now - horizon, end=t_now, limit=8
+    )
+    if history:
+        lines.append("")
+        lines.append("alert history (collector-persisted, newest last):")
+        for rec in history:
+            extras = []
+            if rec.get("exemplar_rid") is not None:
+                extras.append(f"rid={rec['exemplar_rid']}")
+            if rec.get("exemplar_trace"):
+                extras.append(f"trace={rec['exemplar_trace']}")
+            lines.append(
+                f"  {time.strftime('%H:%M:%S', time.localtime(rec.get('ts') or 0))}"
+                f"  {rec.get('action', '?'):34} {rec.get('state', '?'):8}"
+                f"  burn={rec.get('burn_short', '?')}"
+                + ("  " + " ".join(extras) if extras else "")
+            )
+    reqs = store.query(REQUEST_SERIES, start=t_now - horizon, end=t_now)
+    lines.append("")
+    lines.append(
+        f"store: {len(reqs)} request point(s) in the last "
+        f"{horizon / 3600:g}h, {len(store.segments())} segment(s), "
+        f"{len(store.series_names())} series"
+    )
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> None:
+    """``python -m keystone_tpu observe slo <dir> [--config FILE]``."""
+    import sys
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    config = None
+    if "--config" in argv:
+        i = argv.index("--config")
+        if i + 1 >= len(argv):
+            raise SystemExit("--config needs a JSON file argument")
+        config = SLOConfig.from_file(argv[i + 1])
+        del argv[i : i + 2]
+    if not argv or argv[0] in ("-h", "--help"):
+        raise SystemExit(
+            "usage: python -m keystone_tpu observe slo <dir> "
+            "[--config FILE]\n"
+            "<dir> is a collector output directory (contains tsdb/) or\n"
+            "the tsdb directory itself; --config points at a declarative\n"
+            "SLO JSON file (see the README's 'Fleet observability & "
+            "SLOs')"
+        )
+    try:
+        store_dir = resolve_store_dir(argv[0])
+    except OSError as e:
+        raise SystemExit(str(e)) from None
+    store = TimeSeriesStore(store_dir)
+    print(render_status(store, config))

@@ -1,0 +1,452 @@
+"""On-disk time-series store: the fleet collector's durable memory.
+
+One process's telemetry lives in its run dir; a FLEET is N processes
+(router + replicas + trainers) each writing its own. The collector
+(:mod:`.collector`) merges their scraped ``/metrics`` snapshots and
+tailed ``steps``/``events``/``spans`` streams into ONE of these stores,
+and the SLO engine (:mod:`.slo`) and the live dashboard
+(:mod:`.dashboard`) range-query it — the single pane the per-process
+streams never gave the service tier.
+
+Design: append-only JSONL *segments* (``ts-NNNNNN.jsonl``), rolled when
+the active segment passes ``KEYSTONE_TS_SEGMENT_MB``, with retention +
+compaction (:meth:`TimeSeriesStore.compact`) bounding total disk. The
+format stays the repo's one substrate — tolerant JSONL via
+:func:`keystone_tpu.observe.events.read_jsonl` — so a torn final line
+from a killed collector costs one point, never a segment, and plain
+``jq`` still works on the files.
+
+Point schema (one JSON object per line; extra fields free-form)::
+
+    ==========  =========================================================
+    ``ts``      unix time (float, seconds)
+    ``series``  series key — the :func:`..metrics._series_key` format
+                (``name{label=value,...}``), so label escaping has one
+                home across live registries and the store
+    ``value``   float sample
+    (extra)     free-form attributes; request points carry ``ok``,
+                ``trace``/``rid`` (the exemplar an SLO alert links to)
+    ==========  =========================================================
+
+Crash contract: a write lands either as a complete line or as a torn
+final line the readers skip; compaction writes every replacement
+segment fully before deleting any source segment, so a reader never
+sees a torn segment — at worst it sees a few points twice across the
+replace window (the consumers tolerate duplicates; verdicts are
+computed over rates, not exact counts).
+
+The writer is LAZY: constructing a store opens nothing, so read-only
+consumers (``observe slo``, the dashboard) can point one at a live
+collector's directory without contending for the active segment.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+import time
+from typing import Any, Callable, Iterable
+
+from keystone_tpu.observe import events as _events
+
+ENV_SEGMENT_MB = "KEYSTONE_TS_SEGMENT_MB"
+ENV_RETENTION_S = "KEYSTONE_TS_RETENTION_S"
+
+DEFAULT_SEGMENT_BYTES = 4 * 2**20  # 4 MiB per segment before roll
+DEFAULT_RETENTION_S = 24 * 3600.0  # one day of points survives compact
+
+_SEGMENT_RE = re.compile(r"^ts-(\d{6,})\.jsonl$")
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "").strip()
+    if raw:
+        try:
+            v = float(raw)
+            if v > 0:
+                return v
+        except ValueError:
+            pass
+    return default
+
+
+def segment_bytes_from_env() -> int:
+    return int(_env_float(ENV_SEGMENT_MB, DEFAULT_SEGMENT_BYTES / 2**20) * 2**20)
+
+
+def retention_from_env() -> float:
+    return _env_float(ENV_RETENTION_S, DEFAULT_RETENTION_S)
+
+
+class TimeSeriesStore:
+    """Append-only segmented point store under one directory.
+
+    Thread-safe; all disk failures degrade (one warning, writes drop)
+    rather than crash the collector — the same contract as the event
+    log. ``clock`` is injectable so retention math is testable with
+    zero sleeps.
+    """
+
+    def __init__(
+        self,
+        dir: str,
+        *,
+        segment_max_bytes: int | None = None,
+        retention_s: float | None = None,
+        clock: Callable[[], float] = time.time,
+    ):
+        self.dir = dir
+        self.segment_max_bytes = (
+            segment_bytes_from_env()
+            if segment_max_bytes is None
+            else int(segment_max_bytes)
+        )
+        self.retention_s = (
+            retention_from_env() if retention_s is None else float(retention_s)
+        )
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._fh = None  # lazy: opened on first append
+        self._active: str | None = None
+        self._size = 0
+        self._degraded = False
+        # (path, file size) → (min_ts, max_ts) — sealed segments are
+        # immutable so the size key invalidates exactly when a segment
+        # is still growing; lets range queries skip whole files
+        self._meta: dict[str, tuple[int, float, float]] = {}
+        # (path, file size) → series names — same invalidation rule;
+        # keeps the dashboard's every-2s series listing from re-parsing
+        # sealed segments
+        self._names: dict[str, tuple[int, frozenset]] = {}
+
+    # ------------------------------------------------------------ segments
+
+    def segments(self) -> list[str]:
+        """All segment file paths, oldest→newest (sequence order)."""
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return []
+        out = []
+        for name in names:
+            m = _SEGMENT_RE.match(name)
+            if m:
+                out.append((int(m.group(1)), os.path.join(self.dir, name)))
+        return [path for _, path in sorted(out)]
+
+    def _next_seq(self) -> int:
+        seqs = [
+            int(_SEGMENT_RE.match(os.path.basename(p)).group(1))
+            for p in self.segments()
+        ]
+        return (max(seqs) + 1) if seqs else 1
+
+    def _segment_path(self, seq: int) -> str:
+        return os.path.join(self.dir, f"ts-{seq:06d}.jsonl")
+
+    def _open_active(self) -> None:
+        """Open (or resume) the active segment — called under the lock."""
+        os.makedirs(self.dir, exist_ok=True)
+        segs = self.segments()
+        path = None
+        if segs:
+            last = segs[-1]
+            try:
+                if os.path.getsize(last) < self.segment_max_bytes:
+                    path = last
+            except OSError:
+                path = None
+        if path is None:
+            path = self._segment_path(self._next_seq())
+        self._fh = open(path, "a", buffering=1)  # noqa: SIM115 — store-lifetime
+        self._active = path
+        self._size = self._fh.tell()
+
+    def _roll(self) -> None:
+        """Seal the active segment and start the next one (under lock)."""
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+        path = self._segment_path(self._next_seq())
+        self._fh = open(path, "a", buffering=1)  # noqa: SIM115 — store-lifetime
+        self._active = path
+        self._size = 0
+
+    def _degrade(self, err: Exception, what: str) -> None:
+        if not self._degraded:
+            self._degraded = True
+            from keystone_tpu.core.logging import get_logger
+
+            get_logger("keystone_tpu.observe").warning(
+                "time-series store %s: %s failed (%r); writes disabled",
+                self.dir,
+                what,
+                err,
+            )
+        self._fh = None
+
+    # -------------------------------------------------------------- writes
+
+    def append(
+        self, series: str, value: float, *, ts: float | None = None, **attrs: Any
+    ) -> dict:
+        """Append one point; returns the record (written or not)."""
+        rec: dict[str, Any] = {
+            "ts": float(self.clock() if ts is None else ts),
+            "series": str(series),
+            "value": float(value),
+        }
+        for k, v in attrs.items():
+            if v is not None:
+                rec[k] = v
+        line = _events._encode(rec)
+        if line is None:
+            return rec
+        nbytes = len(line.encode("utf-8")) + 1
+        with self._lock:
+            if self._degraded:
+                return rec
+            try:
+                if self._fh is None:
+                    self._open_active()
+                if self._size and self._size + nbytes > self.segment_max_bytes:
+                    self._roll()
+                self._fh.write(line + "\n")
+                self._size += nbytes
+            except OSError as e:
+                self._degrade(e, "append")
+        return rec
+
+    def append_many(self, points: Iterable[tuple[str, float, dict]]) -> int:
+        """Bulk form: ``(series, value, attrs)`` tuples; returns count."""
+        n = 0
+        for series, value, attrs in points:
+            self.append(series, value, **attrs)
+            n += 1
+        return n
+
+    def seal(self) -> None:
+        """Close the active segment handle. The next append re-resolves
+        the newest on-disk segment, so a compaction that ran in between
+        (same process or another) is picked up instead of resurrecting
+        a deleted file."""
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+
+    # -------------------------------------------------------------- reads
+
+    @staticmethod
+    def _read_segment(path: str) -> list[dict]:
+        """One segment's records; [] when the file vanished underneath
+        us — a CONCURRENT compaction (another process's collector)
+        deletes sources after writing survivors, and a reader that
+        listed the old name must degrade to the survivors it can see,
+        never crash (the compact docstring's contract)."""
+        try:
+            return _events.read_jsonl(path)
+        except OSError:
+            return []
+
+    def _segment_span(self, path: str) -> tuple[float, float] | None:
+        """Cached (min_ts, max_ts) of one segment, keyed by file size
+        (sealed segments never change; the active one grows, which
+        changes its size and refreshes the entry). None = unreadable or
+        empty — the caller must scan it."""
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return None
+        hit = self._meta.get(path)
+        if hit is not None and hit[0] == size:
+            return hit[1], hit[2]
+        lo = hi = None
+        for rec in self._read_segment(path):
+            ts = rec.get("ts")
+            if not isinstance(ts, (int, float)):
+                continue
+            lo = ts if lo is None else min(lo, ts)
+            hi = ts if hi is None else max(hi, ts)
+        if lo is None:
+            return None
+        self._meta[path] = (size, lo, hi)
+        return lo, hi
+
+    def query(
+        self,
+        series: str | None = None,
+        *,
+        start: float | None = None,
+        end: float | None = None,
+        prefix: str | None = None,
+        limit: int | None = None,
+    ) -> list[dict]:
+        """Range query: points with ``start <= ts <= end`` (either bound
+        optional), filtered to an exact ``series`` key or a ``prefix``
+        (series name family, e.g. ``"serve_request_seconds"`` matching
+        every labeled instance). Returned oldest→newest; ``limit`` keeps
+        the NEWEST N (``limit=0`` = none). Reads from disk, so any
+        process can query a live collector's store; segments whose
+        cached time span falls outside the range are skipped unread —
+        the dashboard's every-2s recent-window refresh must not re-parse
+        a day of retention."""
+        if limit is not None and limit <= 0:
+            return []
+        out: list[dict] = []
+        for path in self.segments():
+            if start is not None or end is not None:
+                span = self._segment_span(path)
+                if span is not None and (
+                    (start is not None and span[1] < start)
+                    or (end is not None and span[0] > end)
+                ):
+                    continue
+            for rec in self._read_segment(path):
+                ts = rec.get("ts")
+                if not isinstance(ts, (int, float)):
+                    continue
+                if start is not None and ts < start:
+                    continue
+                if end is not None and ts > end:
+                    continue
+                key = rec.get("series")
+                if series is not None and key != series:
+                    continue
+                if prefix is not None and not str(key).startswith(prefix):
+                    continue
+                out.append(rec)
+        out.sort(key=lambda r: r["ts"])
+        if limit is not None and len(out) > limit:
+            out = out[-limit:]
+        return out
+
+    def series_names(self) -> list[str]:
+        """Every distinct series key present in the store, sorted.
+        Cached per sealed segment (size-keyed, like the span index) so
+        the dashboard's refresh loop doesn't re-parse a day of
+        retention to list names."""
+        names: set[str] = set()
+        for path in self.segments():
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            hit = self._names.get(path)
+            if hit is None or hit[0] != size:
+                found = frozenset(
+                    str(rec["series"])
+                    for rec in self._read_segment(path)
+                    if rec.get("series")
+                )
+                hit = (size, found)
+                self._names[path] = hit
+            names |= hit[1]
+        return sorted(names)
+
+    def latest(self, series: str) -> dict | None:
+        """The newest point of one series (None when absent)."""
+        best: dict | None = None
+        for path in self.segments():
+            for rec in self._read_segment(path):
+                if rec.get("series") != series:
+                    continue
+                if best is None or (rec.get("ts") or 0) >= (best.get("ts") or 0):
+                    best = rec
+        return best
+
+    # --------------------------------------------------------- compaction
+
+    def compact(self, now: float | None = None) -> dict:
+        """Merge every segment into fresh ones, dropping points older
+        than ``retention_s`` — the disk bound for a long-lived collector.
+
+        Crash-safe by ordering: survivors are fully written to NEW
+        segment files (higher sequence numbers) before any source
+        segment is deleted, so a reader — or a crash at any instant —
+        never sees a torn segment; the worst case is a short window of
+        duplicated points, which every consumer tolerates.
+        """
+        now = self.clock() if now is None else float(now)
+        horizon = now - self.retention_s
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+            old = self.segments()
+            kept = 0
+            dropped = 0
+            written: list[str] = []
+            seq = self._next_seq()
+            out_fh = None
+            out_size = 0
+            try:
+                for path in old:
+                    for rec in self._read_segment(path):
+                        ts = rec.get("ts")
+                        if not isinstance(ts, (int, float)) or ts < horizon:
+                            dropped += 1
+                            continue
+                        line = _events._encode(rec)
+                        if line is None:
+                            dropped += 1
+                            continue
+                        nbytes = len(line.encode("utf-8")) + 1
+                        if out_fh is None or (
+                            out_size and out_size + nbytes > self.segment_max_bytes
+                        ):
+                            if out_fh is not None:
+                                out_fh.close()
+                            new_path = self._segment_path(seq)
+                            seq += 1
+                            out_fh = open(  # noqa: SIM115 — closed below
+                                new_path, "w", buffering=1
+                            )
+                            written.append(new_path)
+                            out_size = 0
+                        out_fh.write(line + "\n")
+                        out_size += nbytes
+                        kept += 1
+                if out_fh is not None:
+                    out_fh.close()
+                    out_fh = None
+                # every survivor is durable in a complete new segment:
+                # NOW the sources can go
+                for path in old:
+                    try:
+                        os.remove(path)
+                    except OSError:
+                        pass
+                    self._meta.pop(path, None)
+                    self._names.pop(path, None)
+            except OSError as e:
+                if out_fh is not None:
+                    try:
+                        out_fh.close()
+                    except OSError:
+                        pass
+                self._degrade(e, "compact")
+        return {
+            "segments_before": len(old),
+            "segments_after": len(written),
+            "points_kept": kept,
+            "points_dropped": dropped,
+        }

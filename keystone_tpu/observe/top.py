@@ -74,6 +74,94 @@ class Tail:
         return self.records
 
 
+#: fleet mode tails run dirs whose streams moved within this window —
+#: a base dir accumulating months of finished runs must not pour every
+#: dead run's alerts and losses into the live view
+_FLEET_FRESH_S = 3600.0
+
+
+class FleetTails:
+    """Tails EVERY *live* run dir under a base observe directory,
+    rediscovering on each poll. A router and its replicas each write
+    their own run dir; a replica relaunched by a rolling restart writes
+    a NEW one, and the operator staring at the dashboard must see it
+    appear live, not restart ``observe top``. Run dirs whose files
+    haven't moved for :data:`_FLEET_FRESH_S` are skipped at discovery
+    (when nothing is fresh, the newest stale run is tailed so the
+    command still shows something). Files are read through the
+    collector's rotation-safe cursor — a size-capped ``steps.jsonl``
+    rolling to ``.1`` mid-watch must not wipe the dashboard's history —
+    with bounded in-memory accumulation, merged and ts-sorted so
+    :func:`summarize` treats the fleet as one stream."""
+
+    _KEEP = 4096  # records kept per file, the Tail bound
+
+    def __init__(self, base: str):
+        self.base = base
+        self._tails: dict[str, tuple[Any, Any, list[dict], list[dict]]] = {}
+
+    def _fresh(self, run_dir: str) -> float | None:
+        """Newest stream mtime under ``run_dir`` (None = no streams)."""
+        newest = None
+        for f in (_telemetry.STEPS_FILE, _events.EVENTS_FILE):
+            try:
+                mtime = os.path.getmtime(os.path.join(run_dir, f))
+            except OSError:
+                continue
+            newest = mtime if newest is None else max(newest, mtime)
+        return newest
+
+    def _discover(self) -> None:
+        from keystone_tpu.observe.collector import _Cursor
+
+        try:
+            names = os.listdir(self.base)
+        except OSError:
+            return
+        candidates: dict[str, float] = {}
+        for name in sorted(names):
+            run_dir = os.path.join(self.base, name)
+            if run_dir in self._tails or not os.path.isdir(run_dir):
+                continue
+            mtime = self._fresh(run_dir)
+            if mtime is not None:
+                candidates[run_dir] = mtime
+        now = time.time()
+        live = {
+            d for d, m in candidates.items() if now - m <= _FLEET_FRESH_S
+        }
+        if not live and candidates and not self._tails:
+            # nothing fresh anywhere: show the newest finished run
+            live = {max(candidates, key=candidates.get)}
+        for run_dir in sorted(live):
+            self._tails[run_dir] = (
+                _Cursor(os.path.join(run_dir, _telemetry.STEPS_FILE)),
+                _Cursor(os.path.join(run_dir, _events.EVENTS_FILE)),
+                [],
+                [],
+            )
+
+    def poll(self) -> tuple[list[dict], list[dict]]:
+        self._discover()
+        steps: list[dict] = []
+        events: list[dict] = []
+        for step_cur, event_cur, step_kept, event_kept in self._tails.values():
+            for cur, kept in ((step_cur, step_kept), (event_cur, event_kept)):
+                kept.extend(cur.poll())
+                if len(kept) > self._KEEP:
+                    del kept[: len(kept) - self._KEEP]
+            steps.extend(step_kept)
+            events.extend(event_kept)
+        key = lambda r: float(r.get("ts") or 0.0)  # noqa: E731
+        steps.sort(key=key)
+        events.sort(key=key)
+        return steps, events
+
+    @property
+    def run_count(self) -> int:
+        return len(self._tails)
+
+
 def sparkline(values: list[float], width: int = _LOSS_WINDOW) -> str:
     # non-finite values (a NaN'd loss — exactly when someone is staring
     # at the dashboard) render as the full bar instead of crashing the
@@ -529,17 +617,43 @@ def main(argv: list[str] | None = None) -> None:
             "usage: python -m keystone_tpu observe top <run-dir> "
             "[--once] [--interval S]\n"
             "<run-dir> is a directory containing steps.jsonl/events.jsonl,"
-            "\nor a base KEYSTONE_OBSERVE_DIR (the newest run is tailed)"
+            "\nor a base KEYSTONE_OBSERVE_DIR — fleet mode: every LIVE\n"
+            "run dir under it is tailed as one merged stream, and new\n"
+            "ones (replica relaunches, rolling restarts) appear without\n"
+            "a restart; with no live run, the newest finished one shows"
         )
-    try:
-        run_dir = resolve_run_dir(argv[0])
-    except OSError as e:
-        raise SystemExit(str(e)) from None
-    steps = Tail(os.path.join(run_dir, _telemetry.STEPS_FILE))
-    events = Tail(os.path.join(run_dir, _events.EVENTS_FILE))
+    path = argv[0]
+    fleet: FleetTails | None = None
+    if os.path.isdir(path) and not any(
+        os.path.isfile(os.path.join(path, f))
+        for f in (_telemetry.STEPS_FILE, _events.EVENTS_FILE)
+    ):
+        # a BASE observe dir: fleet mode — tail every run dir under it
+        # and keep rediscovering, so replicas relaunched mid-watch (a
+        # rolling restart mints fresh run dirs) appear live
+        fleet = FleetTails(path)
+        fleet._discover()
+        if not fleet._tails:
+            try:
+                resolve_run_dir(path)  # raise the canonical error
+            except OSError as e:
+                raise SystemExit(str(e)) from None
+    if fleet is None:
+        try:
+            run_dir = resolve_run_dir(path)
+        except OSError as e:
+            raise SystemExit(str(e)) from None
+        steps = Tail(os.path.join(run_dir, _telemetry.STEPS_FILE))
+        events = Tail(os.path.join(run_dir, _events.EVENTS_FILE))
     while True:
-        state = summarize(steps.poll(), events.poll())
-        screen = render(state, run_dir)
+        if fleet is not None:
+            step_recs, event_recs = fleet.poll()
+            label = f"{path} [{fleet.run_count} run dir(s)]"
+        else:
+            step_recs, event_recs = steps.poll(), events.poll()
+            label = run_dir
+        state = summarize(step_recs, event_recs)
+        screen = render(state, label)
         if once:
             print(screen)
             return

@@ -101,6 +101,11 @@ SITES: dict[str, str] = {
     "keyed evaluation window — the revert-guard drill: the next "
     "window's goodput regression must walk the knob back "
     "(plan/tune.py; key = evaluation index)",
+    "collector.scrape_fail": "fail the keyed collector scrape attempt — "
+    "a replica dying mid-scrape: the store keeps a gap for that target "
+    "and cycle and collector_scrape_fail increments; the collector must "
+    "never crash or tear a segment (observe/collector.py; key = scrape "
+    "attempt index)",
 }
 
 

@@ -1149,6 +1149,14 @@ class Fleet:
             "shed": snap.get("fleet_shed", 0),
             "failover": snap.get("fleet_failover", 0),
             "hedges": snap.get("fleet_hedges", 0),
+            # the collector's discovery hook: the router advertises
+            # every replica's scrape endpoint (down ones included — a
+            # gap in a known series is signal, an unknown replica is
+            # not), re-read by `observe collect --router` each cycle so
+            # relaunches and rolling restarts surface automatically
+            "scrape_targets": [
+                f"http://{r.host}:{r.port}/metrics" for r in self.replicas
+            ],
         }
         if t.get("count"):
             out["request_p50_ms"] = round(t.get("p50_s", 0.0) * 1e3, 3)

@@ -314,6 +314,13 @@ class ServeApp:
             # /predict right now, and how many hot-swaps got it there
             out["model_version"] = self.model_version
             out["model_swaps"] = self.swap_count
+        # the observability surface: where this process's run streams
+        # live, so a collector that reached /healthz can tail the
+        # advertised dir instead of guessing (one global read when no
+        # sink is active — the health endpoint stays cheap)
+        log = _events.active()
+        if log is not None and log.run_dir:
+            out["run_dir"] = log.run_dir
         # local capture: a concurrent promote/stop can null the attr
         # between the check and the call (ThreadingHTTPServer)
         shadow = self.shadow

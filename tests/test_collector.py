@@ -1,0 +1,1134 @@
+"""Fleet observability control plane: the time-series store
+(segments, retention, compaction), the collector daemon (scrape + tail
++ the ``collector.scrape_fail`` drill), the multi-window burn-rate SLO
+engine (injected clock, zero sleeps), the federation exposition, the
+``observe slo`` / ``observe collect`` CLIs, the live dashboard server,
+``observe top`` fleet auto-discovery — and the end-to-end drill: a
+3-replica fleet with ``fleet.replica_kill`` mid-burst produces an
+availability burn-rate alert whose exemplar resolves through
+``observe trace --request`` to the failed-over request's span tree."""
+
+import json
+import os
+import pathlib
+import sys
+import threading
+import time
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+from keystone_tpu.observe import events, metrics
+from keystone_tpu.observe import slo as slo_mod
+from keystone_tpu.observe.collector import (
+    Collector,
+    federation_text,
+)
+from keystone_tpu.observe.timeseries import TimeSeriesStore
+from keystone_tpu.resilience import faults
+
+STUB = str(pathlib.Path(__file__).parent / "fleet_replica_worker.py")
+
+
+class Clock:
+    def __init__(self, t: float = 1_000_000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# time-series store: segments, range queries, retention + compaction
+
+
+def test_store_rolls_segments_and_queries_ranges(tmp_path):
+    clock = Clock()
+    store = TimeSeriesStore(
+        str(tmp_path), segment_max_bytes=256, retention_s=1e9, clock=clock
+    )
+    for i in range(40):
+        clock.t += 10
+        store.append("s", float(i), tag="x")
+    assert len(store.segments()) > 2  # rolled past the byte cap
+    allp = store.query("s")
+    assert [p["value"] for p in allp] == [float(i) for i in range(40)]
+    # inclusive range bounds
+    lo, hi = allp[10]["ts"], allp[20]["ts"]
+    sub = store.query("s", start=lo, end=hi)
+    assert [p["value"] for p in sub] == [float(i) for i in range(10, 21)]
+    # newest-N limit
+    assert [p["value"] for p in store.query("s", limit=3)] == [37.0, 38.0, 39.0]
+    # prefix matches a labeled family
+    store.append("fam{instance=a}", 1.0)
+    store.append("fam{instance=b}", 2.0)
+    assert len(store.query(prefix="fam")) == 2
+    store.close()
+
+
+def test_store_retention_compaction_roundtrip_across_seam(tmp_path):
+    """Write past the segment cap, compact (dropping the aged half),
+    then range-query across the compacted/live seam — the satellite's
+    round-trip."""
+    clock = Clock()
+    store = TimeSeriesStore(
+        str(tmp_path), segment_max_bytes=300, retention_s=200.0, clock=clock
+    )
+    for i in range(30):
+        clock.t += 10
+        store.append("s", float(i))
+    before = store.segments()
+    assert len(before) > 1
+    res = store.compact()
+    # horizon = now - 200: the first 10 points (300s..100s old) age out
+    assert res["points_dropped"] == 9
+    assert res["points_kept"] == 21
+    assert res["segments_after"] < res["segments_before"]
+    survivors = store.query("s")
+    assert [p["value"] for p in survivors] == [float(i) for i in range(9, 30)]
+    # the store keeps appending after compaction — the seam query spans
+    # a compacted segment and the fresh live one
+    clock.t += 5
+    store.append("s", 99.0)
+    seam = store.query("s", start=survivors[-3]["ts"])
+    assert [p["value"] for p in seam] == [27.0, 28.0, 29.0, 99.0]
+    # every on-disk segment is complete, parseable JSONL (never torn)
+    for path in store.segments():
+        for line in open(path):
+            json.loads(line)
+    store.close()
+
+
+def test_store_is_lazy_for_readers(tmp_path):
+    """Constructing + querying a store opens/creates nothing — the
+    read-only consumers (``observe slo``, the dashboard) must not
+    contend with the collector's writer."""
+    sub = tmp_path / "tsdb"
+    store = TimeSeriesStore(str(sub))
+    assert store.query("s") == []
+    assert store.series_names() == []
+    assert not sub.exists()
+
+
+def test_store_tolerates_torn_final_line(tmp_path):
+    store = TimeSeriesStore(str(tmp_path), segment_max_bytes=1 << 20)
+    store.append("s", 1.0)
+    store.append("s", 2.0)
+    store.close()
+    seg = store.segments()[0]
+    with open(seg, "a") as f:
+        f.write('{"ts": 1, "series": "s", "val')  # killed writer
+    assert [p["value"] for p in store.query("s")] == [1.0, 2.0]
+
+
+def test_store_series_names_and_latest(tmp_path):
+    clock = Clock()
+    store = TimeSeriesStore(str(tmp_path), clock=clock)
+    store.append("a", 1.0)
+    clock.t += 1
+    store.append("b", 2.0)
+    clock.t += 1
+    store.append("a", 3.0)
+    assert store.series_names() == ["a", "b"]
+    assert store.latest("a")["value"] == 3.0
+    assert store.latest("missing") is None
+    store.close()
+
+
+# ---------------------------------------------------------------------------
+# SLO engine: burn-rate units with an injected clock, zero sleeps
+
+
+def _slo_rig(tmp_path, target=0.99):
+    clock = Clock()
+    store = TimeSeriesStore(str(tmp_path / "tsdb"), clock=clock)
+    config = slo_mod.SLOConfig(
+        [
+            slo_mod.Objective(
+                "availability", "availability", target=target, min_points=6
+            )
+        ],
+        [
+            slo_mod.BurnWindow("fast", 60.0, 300.0, 10.0),
+            slo_mod.BurnWindow("slow", 300.0, 1800.0, 6.0),
+        ],
+    )
+    engine = slo_mod.SLOEngine(store, config, clock=clock)
+    return clock, store, engine
+
+
+def _feed_requests(store, now, spec):
+    """spec: list of (age_lo, age_hi, count, ok) bands."""
+    rid = 0
+    for age_lo, age_hi, count, ok in spec:
+        for i in range(count):
+            ts = now - age_hi + (age_hi - age_lo) * (i + 0.5) / count
+            store.append(
+                slo_mod.REQUEST_SERIES,
+                0.01,
+                ts=ts,
+                ok=ok,
+                trace=f"t{rid}",
+                rid=rid,
+            )
+            rid += 1
+
+
+def test_slo_fast_burn_fires_slow_holds_recovery_clears(tmp_path):
+    clock, store, engine = _slo_rig(tmp_path)
+    now = clock.t
+    # 200 good spread over the old half of the slow-long window, 20
+    # good mid-range, 10 bad in the last minute: fast short=100% burn,
+    # fast long ≈ 33%/1% — fires; slow long ≈ 4.3%/1% < 6 — holds
+    _feed_requests(
+        store,
+        now,
+        [(400, 1700, 200, True), (70, 290, 20, True), (10, 50, 10, False)],
+    )
+    with events.run(None) as log:
+        verdicts = {
+            (v["objective"], v["speed"]): v for v in engine.evaluate()
+        }
+        fast = verdicts[("availability", "fast")]
+        slow = verdicts[("availability", "slow")]
+        assert fast["firing"] and fast["transition"] == "fired"
+        assert fast["burn_short"] > 10.0 and fast["burn_long"] > 10.0
+        assert not slow["firing"] and slow["transition"] is None
+        assert slow["burn_long"] < 6.0
+        # the exemplar is a concrete offending request
+        assert fast["exemplar_rid"] is not None
+        assert fast["exemplar_trace"].startswith("t")
+        # one alert event through the schema, phase=slo, state=firing
+        alerts = [r for r in log.records if r["event"] == "alert"]
+        assert len(alerts) == 1
+        assert alerts[0]["action"] == "slo.availability.fast_burn"
+        assert alerts[0]["state"] == "firing"
+        assert alerts[0]["phase"] == "slo"
+        assert alerts[0]["exemplar_rid"] == fast["exemplar_rid"]
+        # steady state: still firing, but NO new transition/event
+        again = {
+            (v["objective"], v["speed"]): v for v in engine.evaluate()
+        }
+        assert again[("availability", "fast")]["firing"]
+        assert again[("availability", "fast")]["transition"] is None
+        assert len([r for r in log.records if r["event"] == "alert"]) == 1
+        # recovery: the bad minute ages out of the short window
+        clock.t += 400
+        cleared = {
+            (v["objective"], v["speed"]): v for v in engine.evaluate()
+        }
+        assert not cleared[("availability", "fast")]["firing"]
+        assert cleared[("availability", "fast")]["transition"] == "cleared"
+        alerts = [r for r in log.records if r["event"] == "alert"]
+        assert len(alerts) == 2 and alerts[-1]["state"] == "cleared"
+        # and clearing is a one-shot too
+        engine.evaluate()
+        assert len([r for r in log.records if r["event"] == "alert"]) == 2
+    store.close()
+
+
+def test_slo_min_points_keeps_empty_windows_quiet(tmp_path):
+    clock, store, engine = _slo_rig(tmp_path)
+    # 3 bad requests (< min_points=6): 100% error rate must NOT page
+    _feed_requests(store, clock.t, [(5, 30, 3, False)])
+    assert not any(v["firing"] for v in engine.evaluate())
+    store.close()
+
+
+def test_slo_latency_objective_exemplar_is_slowest(tmp_path):
+    clock = Clock()
+    store = TimeSeriesStore(str(tmp_path / "tsdb"), clock=clock)
+    config = slo_mod.SLOConfig(
+        [
+            slo_mod.Objective(
+                "latency",
+                "latency",
+                target=0.5,
+                threshold_s=0.1,
+                min_points=4,
+            )
+        ],
+        [slo_mod.BurnWindow("fast", 60.0, 300.0, 1.5)],
+    )
+    engine = slo_mod.SLOEngine(store, config, clock=clock, emit=False)
+    now = clock.t
+    # 5 of 6 over the 100 ms threshold: bad rate 0.83 / budget 0.5 =
+    # burn 1.67 > 1.5 — fires
+    for i, wall in enumerate((0.2, 0.9, 0.3, 0.8, 0.5, 0.01)):
+        store.append(
+            slo_mod.REQUEST_SERIES,
+            wall,
+            ts=now - 30 + i,
+            ok=True,
+            trace=f"t{i}",
+            rid=i,
+        )
+    (v,) = engine.evaluate()
+    assert v["firing"]
+    # the exemplar is the SLOWEST offending request (0.9s → rid 1)
+    assert v["exemplar_rid"] == 1
+    assert v["exemplar_trace"] == "t1"
+    store.close()
+
+
+def test_slo_goodput_floor_objective(tmp_path):
+    clock = Clock()
+    store = TimeSeriesStore(str(tmp_path / "tsdb"), clock=clock)
+    config = slo_mod.SLOConfig(
+        [
+            slo_mod.Objective(
+                "goodput", "goodput", target=0.5, floor=100.0, min_points=4
+            )
+        ],
+        [slo_mod.BurnWindow("fast", 60.0, 300.0, 1.2)],
+    )
+    engine = slo_mod.SLOEngine(store, config, clock=clock, emit=False)
+    now = clock.t
+    for i, rate in enumerate((500.0, 40.0, 20.0, 10.0, 400.0, 30.0)):
+        store.append(
+            slo_mod.GOODPUT_SERIES, rate, ts=now - 30 + i, source="train"
+        )
+    (v,) = engine.evaluate()
+    assert v["firing"]  # 4/6 below floor → rate 0.67 / budget 0.5 = 1.33
+    assert v["kind"] == "goodput"
+    store.close()
+
+
+def test_slo_config_file_and_env_overrides(tmp_path, monkeypatch):
+    cfg_path = tmp_path / "slo.json"
+    cfg_path.write_text(
+        json.dumps(
+            {
+                "objectives": [
+                    {"name": "avail", "kind": "availability", "target": 0.95},
+                    {
+                        "name": "lat",
+                        "kind": "latency",
+                        "target": 0.9,
+                        "threshold_ms": 250,
+                    },
+                ],
+                "fast": {"short_s": 120, "long_s": 600, "factor": 12.0},
+            }
+        )
+    )
+    cfg = slo_mod.SLOConfig.from_file(str(cfg_path))
+    assert [o.name for o in cfg.objectives] == ["avail", "lat"]
+    assert cfg.objectives[1].threshold_s == 0.25
+    fast = cfg.windows[0]
+    assert (fast.short_s, fast.long_s, fast.factor) == (120, 600, 12.0)
+    # env still overrides on top of the file: factor + window scale
+    monkeypatch.setenv("KEYSTONE_SLO_FAST_FACTOR", "3.5")
+    monkeypatch.setenv("KEYSTONE_SLO_WINDOW_SCALE", "0.5")
+    cfg = slo_mod.SLOConfig.from_file(str(cfg_path))
+    assert cfg.windows[0].factor == 3.5
+    assert cfg.windows[0].short_s == 60.0
+    # env-knob default path (no file): availability target override
+    monkeypatch.setenv("KEYSTONE_SLO_AVAILABILITY", "0.9")
+    monkeypatch.setenv("KEYSTONE_SLO_GOODPUT_FLOOR", "50")
+    objectives = slo_mod.default_objectives()
+    assert objectives[0].target == 0.9
+    assert objectives[-1].kind == "goodput" and objectives[-1].floor == 50.0
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition round-trip (the conformance satellite)
+
+
+def test_exposition_parse_roundtrip():
+    reg = metrics.MetricsRegistry()
+    reg.counter("reqs", route="/predict").inc(3)
+    reg.gauge("depth").set(2.5)
+    t = reg.timer("lat")
+    for v in (0.01, 0.02):
+        t.observe(v)
+    reg.counter("odd", label='a,b="c"').inc()
+    samples = metrics.parse_prometheus(reg.to_prometheus())
+    by_name = {}
+    for s in samples:
+        by_name.setdefault(s.name, []).append(s)
+    # counters round-trip under _total with their kind and labels
+    (reqs,) = by_name["reqs_total"]
+    assert reqs.kind == "counter"
+    assert reqs.labels == {"route": "/predict"}
+    assert reqs.value == 3
+    (odd,) = by_name["odd_total"]
+    assert odd.labels["label"] == 'a,b="c"'
+    (depth,) = by_name["depth"]
+    assert depth.kind == "gauge" and depth.value == 2.5
+    # summary family: _count/_sum inherit the family kind
+    (lat_count,) = by_name["lat_count"]
+    assert lat_count.kind == "summary" and lat_count.value == 2
+    quantiles = [s for s in by_name["lat"] if "quantile" in s.labels]
+    assert quantiles, "no quantile samples parsed"
+
+
+# ---------------------------------------------------------------------------
+# collector: scrape, tail, discovery, the scrape_fail drill, federation
+
+
+EXPO_A = (
+    "# HELP reqs_total monotonic count\n"
+    "# TYPE reqs_total counter\n"
+    "reqs_total 5\n"
+    "# TYPE depth gauge\n"
+    'depth{queue="q0"} 1.5\n'
+)
+
+
+def _fake_transport(expo_by_url, healthz=None):
+    def transport(url, timeout, as_json=False):
+        if as_json:
+            if healthz is None:
+                raise ConnectionRefusedError(url)
+            return healthz
+        if url not in expo_by_url:
+            raise ConnectionRefusedError(url)
+        return expo_by_url[url]
+
+    return transport
+
+
+def test_collector_scrape_ingests_instance_labeled_points(tmp_path):
+    clock = Clock()
+    c = Collector(
+        str(tmp_path / "out"),
+        targets=["http://a:1/metrics", "http://b:2/metrics"],
+        clock=clock,
+        transport=_fake_transport(
+            {"http://a:1/metrics": EXPO_A, "http://b:2/metrics": EXPO_A}
+        ),
+    )
+    res = c.scrape_once()
+    assert res == {"targets_ok": 2, "targets_failed": 0, "points": 4}
+    names = c.store.series_names()
+    assert "reqs_total{instance=a:1}" in names
+    assert "depth{instance=b:2,queue=q0}" in names
+    assert c.store.latest("reqs_total{instance=a:1}")["value"] == 5.0
+    c.close()
+
+
+def test_collector_scrape_fail_drill_gap_counter_no_crash(tmp_path):
+    """The satellite drill: a replica dying mid-scrape leaves a gap in
+    the store and a counter bump — never a collector crash or a torn
+    segment."""
+    metrics.get_registry().reset()
+    clock = Clock()
+    c = Collector(
+        str(tmp_path / "out"),
+        targets=["http://a:1/metrics", "http://b:2/metrics"],
+        clock=clock,
+        transport=_fake_transport(
+            {"http://a:1/metrics": EXPO_A, "http://b:2/metrics": EXPO_A}
+        ),
+    )
+    faults.configure("collector.scrape_fail:@1:0")
+    try:
+        res = c.scrape_once()  # attempts 0 (a: ok), 1 (b: injected fail)
+    finally:
+        faults.reset()
+    assert res["targets_ok"] == 1 and res["targets_failed"] == 1
+    assert not any("b:2" in s for s in c.store.series_names())
+    snap = metrics.get_registry().snapshot()
+    assert snap.get("collector_scrape_fail{target=b:2}") == 1
+    # federation marks the dead target down, keeps the live one up
+    c.write_federation()
+    fed = (tmp_path / "out" / "federation.prom").read_text()
+    assert 'up{instance="b:2"} 0' in fed
+    assert 'up{instance="a:1"} 1' in fed
+    # next cycle (attempts 2, 3): the target is scraped again — a gap,
+    # not a death sentence
+    res = c.scrape_once()
+    assert res["targets_failed"] == 0
+    assert any("b:2" in s for s in c.store.series_names())
+    # no torn segments anywhere
+    for path in c.store.segments():
+        for line in open(path):
+            json.loads(line)
+    c.close()
+
+
+def _write_run(run_dir, spans=(), steps=(), events_recs=()):
+    os.makedirs(run_dir, exist_ok=True)
+    for fname, recs in (
+        ("spans.jsonl", spans),
+        ("steps.jsonl", steps),
+        ("events.jsonl", events_recs),
+    ):
+        if recs:
+            with open(os.path.join(run_dir, fname), "a") as f:
+                for rec in recs:
+                    f.write(json.dumps(rec) + "\n")
+
+
+def test_collector_tail_ingests_requests_goodput_and_alerts(tmp_path):
+    base = tmp_path / "obs"
+    now = 1_000_000.0
+    _write_run(
+        str(base / "run-a"),
+        spans=[
+            {"ts": now, "trace": "tA", "span": "s1", "name": "serve.request",
+             "wall_s": 0.02, "rid": 7},
+            {"ts": now + 1, "trace": "tB", "span": "s2", "name": "fleet.forward",
+             "wall_s": 0.5, "rid": 8, "status": "failed"},
+            {"ts": now + 1, "trace": "tC", "span": "s3", "name": "plan.segment",
+             "wall_s": 0.5},  # not a request span: ignored
+        ],
+        steps=[
+            {"ts": now, "source": "train", "step": 1, "loss": 2.5,
+             "tokens_per_s": 1234.0, "mfu": 0.1},
+            {"ts": now, "source": "plan", "rows_per_s": 99.0},
+        ],
+        events_recs=[
+            {"ts": now, "event": "run_start"},
+            {"ts": now + 2, "event": "alert", "action": "train.nan_loss"},
+        ],
+    )
+    c = Collector(str(tmp_path / "out"), watch=[str(base)], clock=Clock(now + 5))
+    n = c.tail_once()
+    reqs = c.store.query(slo_mod.REQUEST_SERIES)
+    assert len(reqs) == 2
+    ok_flags = {p["rid"]: p["ok"] for p in reqs}
+    assert ok_flags == {7: True, 8: False}
+    bad = [p for p in reqs if not p["ok"]][0]
+    assert bad["trace"] == "tB"  # the exemplar link rides the point
+    goodput = c.store.query(slo_mod.GOODPUT_SERIES)
+    assert {p["value"] for p in goodput} == {1234.0, 99.0}
+    assert c.store.query("train.loss")[0]["value"] == 2.5
+    assert c.store.query("alerts")[0]["action"] == "train.nan_loss"
+    assert n >= 6
+    # incremental: nothing new → nothing ingested
+    assert c.tail_once() == 0
+    # a record appended later is picked up exactly once
+    _write_run(
+        str(base / "run-a"),
+        spans=[{"ts": now + 3, "trace": "tD", "span": "s4",
+                "name": "serve.request", "wall_s": 0.01, "rid": 9}],
+    )
+    assert c.tail_once() == 1
+    c.close()
+
+
+def test_collector_counts_one_sample_per_fleet_request(tmp_path):
+    """Behind a fleet, a client request produces a router fleet.forward
+    AND a replica serve.request (parented on the hop) — counting both
+    would halve the measured error rate. Only the router-side hop (and
+    parentless direct-serve requests) are availability samples."""
+    base = tmp_path / "obs"
+    now = 1_000_000.0
+    _write_run(
+        str(base / "run-router"),
+        spans=[{"ts": now, "trace": "t1", "span": "fwd1",
+                "name": "fleet.forward", "wall_s": 0.02, "rid": 1}],
+    )
+    _write_run(
+        str(base / "run-replica"),
+        spans=[
+            # the same request, replica side: parented on the hop
+            {"ts": now, "trace": "t1", "span": "req1", "parent": "fwd1",
+             "name": "serve.request", "wall_s": 0.015, "rid": 0},
+            # a direct (fleet-less) request: root span, IS a sample
+            {"ts": now + 1, "trace": "t2", "span": "req2",
+             "name": "serve.request", "wall_s": 0.01, "rid": 5},
+        ],
+    )
+    c = Collector(str(tmp_path / "out"), watch=[str(base)], clock=Clock(now))
+    c.tail_once()
+    reqs = c.store.query(slo_mod.REQUEST_SERIES)
+    assert len(reqs) == 2
+    assert {p["name"] for p in reqs} == {"fleet.forward", "serve.request"}
+    c.close()
+
+
+def test_collector_router_blip_keeps_scraping_advertised_targets(tmp_path):
+    """One transient /healthz failure (rolling restart, slow router)
+    must not flip every healthy replica to up=0 unscraped — the
+    last-advertised set keeps being scraped through the blip."""
+    state = {"router_up": True}
+
+    def transport(url, timeout, as_json=False):
+        if as_json:
+            if not state["router_up"]:
+                raise TimeoutError("healthz slow")
+            return {"scrape_targets": ["http://rep:1/metrics"]}
+        if url in ("http://rep:1/metrics", "http://r:9/metrics"):
+            return EXPO_A
+        raise ConnectionRefusedError(url)
+
+    c = Collector(
+        str(tmp_path / "out"),
+        router="http://r:9",
+        clock=Clock(),
+        transport=transport,
+    )
+    assert c.scrape_once()["targets_ok"] == 2
+    state["router_up"] = False  # the blip
+    res = c.scrape_once()
+    assert res["targets_ok"] == 2  # replica + router /metrics still scraped
+    fed = federation_text(c._scrapes)
+    assert 'up{instance="rep:1"} 1' in fed
+    c.close()
+
+
+def test_fleet_tails_skip_stale_runs(tmp_path):
+    """A base dir holding months of finished runs must not pour dead
+    alerts/losses into the live fleet view — only fresh run dirs are
+    tailed (with a newest-stale fallback when nothing is live)."""
+    import keystone_tpu.observe.top as top_mod
+
+    base = tmp_path / "obs"
+    _write_run(
+        str(base / "run-old"),
+        steps=[{"ts": 100.0, "source": "train", "step": 9, "loss": 7.0}],
+    )
+    old = os.path.join(str(base / "run-old"), "steps.jsonl")
+    os.utime(old, (time.time() - 7200, time.time() - 7200))
+    _write_run(
+        str(base / "run-live"),
+        steps=[{"ts": time.time(), "source": "train", "step": 1,
+                "loss": 1.0}],
+    )
+    tails = top_mod.FleetTails(str(base))
+    steps, _ = tails.poll()
+    assert tails.run_count == 1
+    assert [r["loss"] for r in steps] == [1.0]
+    # all-stale base: the newest finished run still renders
+    os.utime(
+        os.path.join(str(base / "run-live"), "steps.jsonl"),
+        (time.time() - 7000, time.time() - 7000),
+    )
+    tails2 = top_mod.FleetTails(str(base))
+    steps2, _ = tails2.poll()
+    assert tails2.run_count == 1
+    assert [r["loss"] for r in steps2] == [1.0]  # newest of the stale
+
+
+def test_collector_discovers_new_run_dirs_live(tmp_path):
+    """A replica relaunched by a rolling restart writes a NEW run dir —
+    it must be tailed from the next cycle, no collector restart."""
+    base = tmp_path / "obs"
+    now = 1_000_000.0
+    _write_run(
+        str(base / "run-a"),
+        spans=[{"ts": now, "trace": "t1", "span": "s1",
+                "name": "serve.request", "wall_s": 0.01, "rid": 1}],
+    )
+    c = Collector(str(tmp_path / "out"), watch=[str(base)], clock=Clock(now))
+    assert c.tail_once() == 1
+    _write_run(
+        str(base / "run-b"),
+        spans=[{"ts": now + 1, "trace": "t2", "span": "s2",
+                "name": "serve.request", "wall_s": 0.01, "rid": 2}],
+    )
+    assert c.tail_once() == 1
+    assert {p["rid"] for p in c.store.query(slo_mod.REQUEST_SERIES)} == {1, 2}
+    c.close()
+
+
+def test_collector_router_advertised_targets(tmp_path):
+    """`--router URL`: the fleet router's /healthz advertises its
+    replicas' scrape endpoints; the collector re-reads them each cycle."""
+    expo = {
+        "http://127.0.0.1:7001/metrics": EXPO_A,
+        "http://r:9/metrics": EXPO_A,
+    }
+    c = Collector(
+        str(tmp_path / "out"),
+        router="http://r:9",
+        clock=Clock(),
+        transport=_fake_transport(
+            expo,
+            healthz={
+                "scrape_targets": ["http://127.0.0.1:7001/metrics"],
+                "status": "ok",
+            },
+        ),
+    )
+    targets = c.discover_targets()
+    assert targets == [
+        "http://127.0.0.1:7001/metrics",
+        "http://r:9/metrics",
+    ]
+    res = c.scrape_once()
+    assert res["targets_ok"] == 2
+    assert any("127.0.0.1:7001" in s for s in c.store.series_names())
+    c.close()
+
+
+def test_fleet_snapshot_advertises_scrape_targets():
+    from keystone_tpu.serve.fleet import Fleet
+
+    def transport(replica, method, path, body=None, timeout=5.0, headers=None):
+        return 200, {"draining": False}
+
+    fleet = Fleet(cmd=None, n=3, transport=transport, retry_sleep=lambda s: None)
+    for r in fleet.replicas:
+        r.state = "up"
+    snap = fleet.snapshot()
+    targets = snap["scrape_targets"]
+    assert len(targets) == 3
+    for r, t in zip(fleet.replicas, targets):
+        assert t == f"http://{r.host}:{r.port}/metrics"
+
+
+def test_server_healthz_advertises_run_dir(tmp_path):
+    """The replica-side discovery hook: /healthz names the run dir this
+    process streams into while a sink is active."""
+    from keystone_tpu.serve.server import ServeApp
+
+    class FakeExported:
+        buckets = (8,)
+
+        def __call__(self, batch):
+            return np.asarray(batch) * 2.0
+
+    app = ServeApp(exported=FakeExported(), deadline_ms=5.0)
+    try:
+        with events.run(str(tmp_path)) as log:
+            health = app.health()
+            assert health["run_dir"] == log.run_dir
+        assert "run_dir" not in app.health()  # sink gone → hook gone
+    finally:
+        app.shutdown()
+
+
+def test_federation_text_merges_instances():
+    scrapes = {
+        "http://a:1/metrics": {
+            "instance": "a:1",
+            "up": True,
+            "samples": metrics.parse_prometheus(EXPO_A),
+        },
+        "http://b:2/metrics": {"instance": "b:2", "up": False},
+    }
+    fed = federation_text(scrapes)
+    assert 'reqs_total{instance="a:1"} 5' in fed
+    assert "# TYPE reqs_total counter" in fed
+    assert 'up{instance="a:1"} 1' in fed
+    assert 'up{instance="b:2"} 0' in fed
+    # one TYPE line per family even with many instances
+    assert fed.count("# TYPE up gauge") == 1
+    # round-trips through the parser
+    parsed = metrics.parse_prometheus(fed)
+    ups = {s.labels["instance"]: s.value for s in parsed if s.name == "up"}
+    assert ups == {"a:1": 1.0, "b:2": 0.0}
+
+
+def test_collector_cycle_emits_declared_event(tmp_path):
+    base = tmp_path / "obs"
+    _write_run(
+        str(base / "run-a"),
+        steps=[{"ts": 1.0, "source": "train", "step": 1, "loss": 1.0}],
+    )
+    c = Collector(str(tmp_path / "out"), watch=[str(base)], clock=Clock())
+    with events.run(None) as log:
+        summary = c.cycle()
+        recs = [r for r in log.records if r["event"] == "collector"]
+    assert len(recs) == 1
+    assert recs[0]["cycle"] == 1
+    assert summary["run_dirs"] == 1
+    from keystone_tpu.observe import schema
+
+    assert "collector" in schema.declared()
+    c.close()
+
+
+def test_report_renders_collector_section():
+    from keystone_tpu.observe import report
+
+    summary = report.summarize(
+        [
+            {"event": "collector", "cycle": 1, "targets_ok": 3,
+             "targets_failed": 1, "points": 42, "tailed_points": 7,
+             "run_dirs": 4, "slo_firing": 2},
+        ]
+    )
+    lines = report._collector_section(summary)
+    text = "\n".join(lines)
+    assert "3 target(s) ok" in text and "1 failed" in text
+    assert "FIRING" in text
+
+
+# ---------------------------------------------------------------------------
+# CLIs: observe collect --once, observe slo, observe serve, observe top
+
+
+def test_observe_collect_once_cli(tmp_path, capsys):
+    from keystone_tpu.observe.report import main as cli_main
+
+    base = tmp_path / "obs"
+    _write_run(
+        str(base / "run-a"),
+        spans=[{"ts": time.time(), "trace": "t", "span": "s",
+                "name": "serve.request", "wall_s": 0.01, "rid": 0}],
+    )
+    out = tmp_path / "out"
+    cli_main(
+        ["collect", str(out), "--watch", str(base), "--once",
+         "--interval", "9"]
+    )
+    summary = json.loads(capsys.readouterr().out.strip())
+    assert summary["tailed_points"] == 1
+    assert (out / "tsdb").is_dir()
+    assert (out / "federation.prom").exists()
+    # usage errors are clean SystemExits
+    with pytest.raises(SystemExit):
+        cli_main(["collect"])
+
+
+def test_observe_slo_cli_renders_status(tmp_path, capsys):
+    from keystone_tpu.observe.report import main as cli_main
+
+    out = tmp_path / "out"
+    store = TimeSeriesStore(str(out / "tsdb"))
+    now = time.time()
+    for i in range(12):
+        store.append(
+            slo_mod.REQUEST_SERIES, 0.01, ts=now - 20 + i,
+            ok=(i > 3), trace=f"t{i}", rid=i,
+        )
+    store.close()
+    cli_main(["slo", str(out)])
+    text = capsys.readouterr().out
+    assert "availability" in text and "FIRING" in text
+    assert "rid=" in text  # the exemplar rides the status line
+    with pytest.raises(SystemExit):
+        cli_main(["slo"])
+    with pytest.raises(SystemExit):
+        cli_main(["slo", str(tmp_path / "nope")])
+
+
+def test_dashboard_endpoints(tmp_path):
+    from keystone_tpu.observe import dashboard
+
+    out = tmp_path / "out"
+    base = tmp_path / "obs"
+    now = time.time()
+    _write_run(
+        str(base / "run-a"),
+        spans=[
+            {"ts": now - 20 + i, "trace": f"t{i}", "span": f"s{i}",
+             "name": "serve.request", "wall_s": 0.01, "rid": i,
+             **({"status": "failed"} if i < 3 else {})}
+            for i in range(12)
+        ],
+    )
+    c = Collector(str(out), watch=[str(base)])
+    c.cycle()
+    c.close()
+    httpd = dashboard.serve(str(out), port=0)
+    port = httpd.server_address[1]
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    try:
+        url = f"http://127.0.0.1:{port}"
+        with urllib.request.urlopen(f"{url}/api/slo", timeout=10) as r:
+            slo_payload = json.load(r)
+        firing = [v for v in slo_payload["objectives"] if v["firing"]]
+        assert firing and firing[0]["exemplar_rid"] is not None
+        q = (
+            f"{url}/api/query?series="
+            + urllib.parse.quote(slo_mod.REQUEST_SERIES)
+            + "&limit=5"
+        )
+        with urllib.request.urlopen(q, timeout=10) as r:
+            points = json.load(r)["points"]
+        assert len(points) == 5
+        with urllib.request.urlopen(f"{url}/api/summary", timeout=10) as r:
+            summary = json.load(r)
+        assert slo_mod.REQUEST_SERIES in summary["timeline_series"]
+        assert summary["alerts"], "SLO transition missing from alert feed"
+        with urllib.request.urlopen(f"{url}/metrics", timeout=10) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+        with urllib.request.urlopen(url + "/", timeout=10) as r:
+            assert b"keystone fleet" in r.read()
+        with urllib.request.urlopen(f"{url}/api/series", timeout=10) as r:
+            assert slo_mod.REQUEST_SERIES in json.load(r)["series"]
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_observe_top_fleet_base_auto_discovers_new_run_dirs(tmp_path, capsys):
+    from keystone_tpu.observe.report import main as cli_main
+    from keystone_tpu.observe.top import FleetTails
+
+    base = tmp_path / "obs"
+    now = time.time()
+    _write_run(
+        str(base / "run-router"),
+        events_recs=[{"ts": now, "event": "run_start", "run": "router"}],
+        steps=[{"ts": now, "source": "serve", "bucket": 8, "rows": 8,
+                "batch_fill": 1.0}],
+    )
+    _write_run(
+        str(base / "run-replica1"),
+        events_recs=[{"ts": now, "event": "run_start", "run": "rep1"}],
+        steps=[{"ts": now + 0.1, "source": "train", "step": 3, "loss": 1.5}],
+    )
+    tails = FleetTails(str(base))
+    steps, evs = tails.poll()
+    assert tails.run_count == 2
+    assert len(steps) == 2 and len(evs) == 2
+    # merged stream is ts-ordered
+    assert [r.get("source") for r in steps] == ["serve", "train"]
+    # a run dir born AFTER the first poll appears on the next one — the
+    # rolling-restart story
+    _write_run(
+        str(base / "run-replica2"),
+        steps=[{"ts": now + 1, "source": "train", "step": 1, "loss": 9.0}],
+    )
+    steps, _ = tails.poll()
+    assert tails.run_count == 3
+    assert any(r.get("loss") == 9.0 for r in steps)
+    # the CLI's base-dir form uses fleet mode and says so
+    cli_main(["top", str(base), "--once"])
+    screen = capsys.readouterr().out
+    assert "run dir(s)" in screen
+    assert "steps 1" in screen  # replica2's train row renders
+
+
+def test_store_query_limit_zero_and_pruned_ranges(tmp_path):
+    clock = Clock()
+    store = TimeSeriesStore(
+        str(tmp_path), segment_max_bytes=200, retention_s=1e9, clock=clock
+    )
+    for i in range(20):
+        clock.t += 10
+        store.append("s", float(i))
+    assert store.query("s", limit=0) == []
+    # range answers are identical with the segment-span cache warm
+    lo = store.query("s")[15]["ts"]
+    first = store.query("s", start=lo)
+    again = store.query("s", start=lo)
+    assert [p["value"] for p in first] == [p["value"] for p in again]
+    assert [p["value"] for p in first] == [15.0, 16.0, 17.0, 18.0, 19.0]
+    # the active segment keeps growing past the cached span — new
+    # points in range must still appear
+    clock.t += 10
+    store.append("s", 99.0)
+    assert [p["value"] for p in store.query("s", start=lo)][-1] == 99.0
+    store.close()
+
+
+def test_cursor_recovers_rotated_tail(tmp_path):
+    """JsonlSink-style rotation between polls: records appended after
+    the cursor's offset move to `.1` — they must be ingested, not lost
+    (the failures a replica writes right before rotating are exactly
+    the SLO points that matter)."""
+    from keystone_tpu.observe.collector import _Cursor
+
+    path = str(tmp_path / "spans.jsonl")
+    with open(path, "w") as f:
+        f.write('{"a": 1}\n{"a": 2}\n')
+    cur = _Cursor(path)
+    assert [r["a"] for r in cur.poll()] == [1, 2]
+    # writer appends two more (unread), rotates, starts fresh
+    with open(path, "a") as f:
+        f.write('{"a": 3}\n{"a": 4}\n')
+    os.replace(path, path + ".1")
+    with open(path, "w") as f:
+        f.write('{"a": 5}\n')
+    assert [r["a"] for r in cur.poll()] == [3, 4, 5]
+    # and the new generation tails incrementally from here
+    with open(path, "a") as f:
+        f.write('{"a": 6}\n')
+    assert [r["a"] for r in cur.poll()] == [6]
+
+
+def test_federation_marks_vanished_targets_down(tmp_path):
+    """A target that drops out of discovery (router death, replica
+    de-registered) must stop advertising up=1 with frozen samples."""
+    state = {"targets": ["http://a:1/metrics", "http://b:2/metrics"]}
+
+    def transport(url, timeout, as_json=False):
+        if as_json:
+            raise ConnectionRefusedError(url)
+        if url not in state["targets"]:
+            raise ConnectionRefusedError(url)
+        return EXPO_A
+
+    c = Collector(
+        str(tmp_path / "out"),
+        targets=["http://a:1/metrics"],
+        clock=Clock(),
+        transport=transport,
+    )
+    c.targets = list(state["targets"])
+    assert c.scrape_once()["targets_ok"] == 2
+    # b vanishes from the discovered set entirely
+    c.targets = ["http://a:1/metrics"]
+    c.scrape_once()
+    fed = federation_text(c._scrapes)
+    assert 'up{instance="a:1"} 1' in fed
+    assert 'up{instance="b:2"} 0' in fed
+    c.close()
+
+
+def test_collector_cycle_compacts_on_schedule(tmp_path):
+    """The daemon loop is what makes retention real: aged points are
+    dropped by a scheduled compact inside cycle(), not by an operator
+    remembering to run one."""
+    clock = Clock()
+    c = Collector(str(tmp_path / "out"), clock=clock)
+    c.store.retention_s = 100.0
+    c.compact_every_s = 60.0
+    c.store.append("s", 1.0, ts=clock.t - 500)
+    c.store.append("s", 2.0, ts=clock.t)
+    assert "compacted" not in c.cycle()  # not due yet
+    clock.t += 61
+    summary = c.cycle()
+    assert summary["compacted"]["points_dropped"] >= 1
+    assert [p["value"] for p in c.store.query("s")] == [2.0]
+    c.close()
+
+
+def test_store_readers_survive_segment_vanishing(tmp_path):
+    """A concurrent compaction (another process) deletes sources after
+    writing survivors; a reader that listed the old names must degrade,
+    not crash."""
+    store = TimeSeriesStore(str(tmp_path), segment_max_bytes=200)
+    for i in range(10):
+        store.append("s", float(i), ts=1000.0 + i)
+    store.close()
+    reader = TimeSeriesStore(str(tmp_path))
+    real_segments = reader.segments()
+
+    def racy_segments():
+        return real_segments + [str(tmp_path / "ts-999999.jsonl")]
+
+    reader.segments = racy_segments  # a name that vanished
+    assert len(reader.query("s")) == 10
+    assert reader.series_names() == ["s"]
+    assert reader.latest("s")["value"] == 9.0
+
+
+def test_collector_persists_burn_gauges_for_dashboard(tmp_path):
+    base = tmp_path / "obs"
+    now = time.time()
+    _write_run(
+        str(base / "run-a"),
+        spans=[
+            {"ts": now - 20 + i, "trace": f"t{i}", "span": f"s{i}",
+             "name": "serve.request", "wall_s": 0.01, "rid": i,
+             **({"status": "failed"} if i < 4 else {})}
+            for i in range(12)
+        ],
+    )
+    c = Collector(str(tmp_path / "out"), watch=[str(base)])
+    c.cycle()
+    burns = c.store.query(prefix="slo_burn{")
+    assert burns, "no burn gauge points persisted"
+    by_series = {p["series"] for p in burns}
+    assert any("objective=availability" in s and "speed=fast" in s
+               for s in by_series)
+    firing = [p for p in burns if p.get("firing")]
+    assert firing and firing[0]["value"] > 14.4
+    c.close()
+
+
+# ---------------------------------------------------------------------------
+# the end-to-end drill: 3-replica fleet, replica_kill mid-burst →
+# availability burn-rate alert → exemplar resolves via observe trace
+
+
+def test_fleet_kill_drill_burn_rate_alert_with_trace_exemplar(tmp_path):
+    from keystone_tpu.observe import dashboard
+    from keystone_tpu.observe import spans as spans_mod
+    from keystone_tpu.observe.report import main as cli_main
+    from keystone_tpu.serve.fleet import Fleet
+
+    base = tmp_path / "obs"
+    out = tmp_path / "collector"
+    env = {**os.environ, "STUB_DRAIN_S": "0.1"}
+    fleet = Fleet(
+        cmd=[sys.executable, STUB, "--port", "{port}"],
+        n=3,
+        env=env,
+        poll_s=0.1,
+        grace_s=5.0,
+        boot_timeout_s=30.0,
+        deadline_ms=5000.0,
+        max_inflight=64,
+        hedge=False,
+    )
+    faults.configure("fleet.replica_kill:@8:0")
+    try:
+        fleet.start(wait_up=3, timeout=30.0)
+        with events.run(str(base)):
+            for _ in range(24):
+                payload = fleet.forward("/predict", {"rows": [[1.0, 2.0]]})
+                # the kill drill never costs a CLIENT request — failover
+                # absorbs the death (PR 12's contract)
+                assert payload["predictions"] == [[2.0, 4.0]]
+    finally:
+        faults.reset()
+        fleet.shutdown(grace_s=5.0)
+    snap = metrics.get_registry().snapshot()
+    assert snap.get("fleet_failover", 0) >= 1
+
+    # the collector aggregates the router's spans; the default SLO
+    # config (99.9% availability) sees the failed dispatch in-window
+    collector = Collector(
+        str(out),
+        watch=[str(base)],
+        slo_config=slo_mod.SLOConfig(
+            slo_mod.default_objectives(),
+            [slo_mod.DEFAULT_FAST, slo_mod.DEFAULT_SLOW],
+        ),
+    )
+    with events.run(None) as log:
+        collector.cycle()
+        alert_events = [r for r in log.records if r["event"] == "alert"]
+    reqs = collector.store.query(slo_mod.REQUEST_SERIES)
+    bad = [p for p in reqs if not p.get("ok", True)]
+    assert bad, "the killed dispatch left no failed request point"
+    fired = [
+        a
+        for a in alert_events
+        if a["action"] == "slo.availability.fast_burn"
+        and a["state"] == "firing"
+    ]
+    assert fired, f"no availability fast-burn alert in {alert_events}"
+    rid = fired[0].get("exemplar_rid")
+    trace = fired[0].get("exemplar_trace")
+    assert rid is not None and trace
+
+    # `observe slo <dir>` renders the firing verdict with the exemplar
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        cli_main(["slo", str(out)])
+    text = buf.getvalue()
+    assert "availability" in text and "FIRING" in text
+    assert f"rid={rid}" in text
+
+    # the live dashboard shows the same verdict
+    httpd = dashboard.serve(str(out), port=0)
+    port = httpd.server_address[1]
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/api/slo", timeout=10
+        ) as r:
+            verdicts = json.load(r)["objectives"]
+        assert any(
+            v["objective"] == "availability" and v["firing"] for v in verdicts
+        )
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+    # and the exemplar resolves to the failed-over request's span tree:
+    # router request root → failed forward + the winning retry
+    spans_all = spans_mod.read_spans_all(str(base))
+    rendered = spans_mod.render_traces(spans_all, request=str(rid))
+    assert "fleet.request" in rendered
+    assert "fleet.forward" in rendered
+    assert "FAILED" in rendered
+    collector.close()

@@ -630,20 +630,33 @@ def test_metrics_to_prometheus_exposition():
     for v in (0.01, 0.02, 0.03):
         t.observe(v)
     reg.counter("weird", label='a"b\\c\nd').inc()
+    reg.describe("depth", "queue depth right now")
     text = reg.to_prometheus()
-    assert "# TYPE reqs counter" in text
-    assert 'reqs{route="/predict"} 2' in text
+    # counters expose under the conformant _total suffix; every family
+    # carries HELP + TYPE (described or auto-generated)
+    assert "# TYPE reqs_total counter" in text
+    assert "# HELP reqs_total " in text
+    assert 'reqs_total{route="/predict"} 2' in text
     assert "# TYPE depth gauge" in text and "depth 1.5" in text
+    assert "# HELP depth queue depth right now" in text
     assert "# TYPE lat summary" in text
     assert "lat_count 3" in text
     assert "lat_sum 0.06" in text
     assert 'lat{quantile="0.5"} 0.02' in text
-    assert 'weird{label="a\\"b\\\\c\\nd"} 1' in text
+    assert 'weird_total{label="a\\"b\\\\c\\nd"} 1' in text
+    # a name already ending in _total is not doubled
+    reg.counter("already_total").inc()
+    assert "already_total 1" in reg.to_prometheus()
+    assert "already_total_total" not in reg.to_prometheus()
     # every line is exposition-shaped
     for line in text.strip().splitlines():
-        assert line.startswith("# TYPE") or re.match(
+        assert line.startswith(("# TYPE", "# HELP")) or re.match(
             r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{.*\})? \S+$", line
         ), line
+    # the JSON negotiation path is byte-compatible: snapshot keys stay
+    # the bare registry series keys, no _total anywhere
+    assert 'reqs{route=/predict}' in reg.snapshot()
+    assert not any("_total" in k for k in reg.snapshot() if k != "already_total")
 
 
 def test_metrics_endpoint_content_negotiation(free_tcp_port):
