@@ -1663,6 +1663,72 @@ def bench_fleet_latency(
     return out
 
 
+def bench_chaos_drill() -> dict:
+    """Composed-fault recovery record (resilience/chaos.py): the canned
+    fleet game-day campaign — replica SIGKILL + conn reset + slow
+    replica injected mid-burst against 3 CPU-pinned stub replicas —
+    run end to end through `chaos run`'s engine. The record pins the
+    client-visible outcome (zero failures), the failover count, and
+    the campaign wall, so a regression in composed-fault recovery
+    fails the bench gate exactly like a perf number."""
+    import tempfile as _tempfile
+    import time as _time
+
+    from keystone_tpu.resilience.chaos import run_campaign
+
+    report = _tempfile.mkdtemp(prefix="keystone-bench-chaos-")
+    t0 = _time.perf_counter()
+    try:
+        result = run_campaign("fleet_game_day", report_dir=report)
+    except Exception as e:
+        # a crashed campaign (boot failure, OSError) must still point
+        # the operator at whatever evidence landed on disk
+        raise RuntimeError(
+            f"chaos_drill: campaign crashed ({e!r}); partial evidence "
+            f"under {report}"
+        ) from e
+    wall = _time.perf_counter() - t0
+    w = result.get("workload") or {}
+    out = {
+        "campaign": result["campaign"],
+        "passed": bool(result["passed"]),
+        "invariants_ok": sum(
+            1 for v in result["invariants"] if v["ok"]
+        ),
+        "invariants_total": len(result["invariants"]),
+        "client_ok": int(w.get("client_ok", 0)),
+        "client_failures": int(w.get("client_failures", 0)),
+        "failover": next(
+            (
+                float(v.get("evidence", {}).get("failover") or 0.0)
+                for v in result["invariants"]
+                if v["name"].startswith("failover_fired")
+            ),
+            0.0,
+        ),
+        "request_p95_ms": w.get("request_p95_ms", 0.0),
+        "requests_per_s": (
+            round(w.get("client_ok", 0) / w["wall_s"], 1)
+            if w.get("wall_s")
+            else 0.0
+        ),
+        "campaign_wall_s": round(result.get("wall_s", wall), 2),
+    }
+    if not result["passed"]:
+        out["failed_invariants"] = [
+            v["name"] for v in result["invariants"] if not v["ok"]
+        ]
+        raise RuntimeError(
+            f"chaos_drill: fleet game day FAILED "
+            f"({out['failed_invariants']}); evidence preserved under "
+            f"{report}"
+        )
+    import shutil as _shutil
+
+    _shutil.rmtree(report, ignore_errors=True)
+    return out
+
+
 def bench_sift() -> dict:
     """Dense-SIFT featurize, device (XLA) path, with the C++ host kernel
     (native/dsift.cpp, the VLFeat-shim parity fallback) as baseline."""
@@ -2019,6 +2085,17 @@ def main(argv: list[str] | None = None) -> int | None:
         result["autotune"] = bench_autotune()
     except Exception as e:  # noqa: BLE001 — same contract as above
         result["autotune"] = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+    # composed-fault recovery gate (resilience/chaos.py): the canned
+    # fleet game-day campaign on CPU-pinned stub replicas — zero client
+    # failures, failover count, campaign wall — so a regression in
+    # composed-fault recovery fails --check like a perf number; pure
+    # host work, runs everywhere
+    try:
+        result["chaos_drill"] = bench_chaos_drill()
+    except Exception as e:  # noqa: BLE001 — same contract as above
+        result["chaos_drill"] = {
+            "error": f"{type(e).__name__}: {str(e)[:200]}"
+        }
     # fleet-observability overhead (observe/collector.py): the same
     # jitted loop bare vs instrumented with a live collector scraping +
     # tailing it — pins whole-system observability < 5% of throughput;

@@ -60,6 +60,7 @@ COMMANDS = {
     "serve": "keystone_tpu.serve.server",
     "fleet": "keystone_tpu.serve.fleet",
     "refit": "keystone_tpu.learn.refit",
+    "chaos": "keystone_tpu.resilience.chaos",
 }
 
 
@@ -120,7 +121,11 @@ def main(argv: list[str] | None = None) -> None:
             f" see `fleet --help`;\n"
             f" `refit <state> --watch DIR` folds live labeled chunks into\n"
             f" streaming-fit state and republishes versioned models — see\n"
-            f" `refit --help`)"
+            f" `refit --help`;\n"
+            f" `chaos run <campaign.json>` executes a composed multi-fault\n"
+            f" game day against a fleet/train/refit workload and verdicts\n"
+            f" its declarative invariants from the observe substrate —\n"
+            f" `chaos list` shows the canned campaigns, see `chaos --help`)"
         )
     if argv[0] in COMMANDS:
         import importlib

@@ -359,6 +359,11 @@ def _save_leaves(mgr, step: int, state) -> None:
 
     def _attempt():
         faults.maybe_raise("ckpt.save", note=f"step {step}")
+        # the disk-full drill, keyed by the step like ckpt.save: ENOSPC
+        # is NOT transient (the retry classifier fails it straight
+        # through), so the caller's degrade path — keep training on the
+        # previous checkpoint, loudly — is what actually gets exercised
+        faults.maybe_disk_full(key=int(step), note=f"step {step}")
         mgr.save(
             int(step),
             args=ocp.args.StandardSave(

@@ -34,6 +34,24 @@ _MAGIC = b"KSTP1\n"
 _MAGIC_FITTED = b"KSTF1\n"
 
 
+def _fsync_dir(dirname: str) -> None:
+    """fsync a directory so a just-committed ``os.replace`` rename
+    survives power loss — without it the data blocks are durable but
+    the directory entry pointing at them may not be, and a crash at the
+    wrong instant silently resurrects the OLD artifact. Best-effort on
+    filesystems that refuse directory fds."""
+    try:
+        fd = os.open(dirname or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 @contextlib.contextmanager
 def atomic_write(path: str):
     """Write-to-temp + ``os.replace`` in the target's own directory, so
@@ -42,14 +60,30 @@ def atomic_write(path: str):
     sees either the old complete artifact or the new complete artifact,
     never a torn one. Yields the open binary file handle; the replace
     happens only when the body completes (a failed write leaves the old
-    file untouched and removes the temp)."""
+    file untouched and removes the temp). Durability is full-path: the
+    temp is fsynced before the rename and the parent directory after
+    it, so "committed" means committed across a crash, not just across
+    a concurrent reader. The ``ckpt.disk_full`` fault site fires here
+    (ENOSPC before the fsync), proving every writer on this path
+    degrades loudly while the old artifact survives."""
     tmp = f"{path}.tmp.{os.getpid()}"
     try:
         with open(tmp, "wb") as f:
             yield f
+            from keystone_tpu.resilience import faults as _faults
+
+            # keyed by the artifact's file name (never an integer), so
+            # a campaign's `at: N` step targets the checkpoint-save
+            # bracket's step keys without aliasing onto whichever
+            # atomic_write happens to run Nth — probability clauses
+            # still hit every write
+            _faults.maybe_disk_full(
+                key=os.path.basename(path), note=path
+            )
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
+        _fsync_dir(os.path.dirname(os.path.abspath(path)))
     finally:
         with contextlib.suppress(OSError):
             os.unlink(tmp)

@@ -335,6 +335,7 @@ def train(
     from keystone_tpu.parallel.mesh import data_sharding
     from keystone_tpu.resilience import cluster as _cluster
     from keystone_tpu.resilience import faults as _faults
+    from keystone_tpu.resilience.retry import RetryExhausted
     from keystone_tpu.resilience.guards import (
         LossGuard,
         NumericalHealthError,
@@ -663,14 +664,38 @@ def train(
             if ckpt is not None and (
                 (i + 1) % every == 0 or (i + 1) == steps
             ):
-                with _spans.span(
-                    "train.checkpoint",
-                    bucket="checkpoint",
-                    trace=_train_trace,
-                    step=i + 1,
-                ):
-                    ckpt.save((model, opt_state), i + 1)
-                last_saved = i + 1
+                try:
+                    with _spans.span(
+                        "train.checkpoint",
+                        bucket="checkpoint",
+                        trace=_train_trace,
+                        step=i + 1,
+                    ):
+                        ckpt.save((model, opt_state), i + 1)
+                    last_saved = i + 1
+                except (OSError, RetryExhausted) as e:
+                    # a full disk / exhausted IO retries at a PERIODIC
+                    # save must not kill hours of training: the previous
+                    # checkpoint is intact (atomic save), so degrade
+                    # loudly and try again next interval — the risk
+                    # window widens by one interval, the run survives.
+                    # (A coordinated-barrier failure is a membership
+                    # problem, not an IO one — ClusterBarrierError still
+                    # propagates above.)
+                    logger.warning(
+                        "periodic checkpoint save at step %d failed "
+                        "(%r); continuing on the step-%d checkpoint",
+                        i + 1,
+                        e,
+                        last_saved,
+                    )
+                    _emit_resilience(
+                        "ckpt_save_failed",
+                        counter="ckpt_save_failures",
+                        step=i + 1,
+                        last_saved=last_saved,
+                        error=repr(e),
+                    )
             if _faults.fire("train.sigterm", key=i):
                 if prev_handlers:
                     # a REAL signal to this process: exercises the

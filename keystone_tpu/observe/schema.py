@@ -56,6 +56,10 @@ EVENT_KINDS: dict[str, str] = {
     "failed, points ingested, run dirs tailed, SLO verdicts firing "
     "(observe/collector.py); SLO burn-rate transitions ride the "
     "'alert' kind with phase=slo (observe/slo.py)",
+    "chaos": "a chaos-campaign lifecycle record: campaign_start with "
+    "the compiled fault schedule, process-level chaos_action steps, "
+    "and the final verdict with per-invariant PASS/FAIL "
+    "(resilience/chaos.py)",
 }
 
 _warned: set[str] = set()

@@ -22,8 +22,11 @@ of ingest-level skip/retry as a framework concern:
   heartbeats, host-loss detection, coordinated-checkpoint barriers, and
   the exit-code protocol :mod:`.supervisor` (``python -m keystone_tpu
   supervise``) drives to relaunch a job on the surviving host set.
+- :mod:`.chaos` — the campaign engine on top of all of it: composed
+  multi-fault game days (``python -m keystone_tpu chaos run``) whose
+  declarative invariants are verdicted from the observe substrate.
 
-All four are stdlib-light at import (jax loads lazily inside
+All of them are stdlib-light at import (jax loads lazily inside
 functions) so the loaders and core pipeline can depend on them without
 widening their import graph. Every retry/skip/guard/watchdog decision
 emits through :mod:`keystone_tpu.observe` (events tagged
@@ -34,6 +37,7 @@ exactly what was survived.
 from __future__ import annotations
 
 from keystone_tpu.resilience import (  # noqa: F401
+    chaos,
     cluster,
     faults,
     guards,

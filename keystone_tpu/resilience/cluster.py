@@ -218,6 +218,10 @@ class ClusterMonitor:
         # last CHANGED). Local monotonic time only — never a cross-host
         # wall-clock comparison.
         self._seen: dict[int, tuple[str | None, float]] = {}
+        self._kv_reads = 0  # kv.partition read-key counter (string
+        # domain "read:N" — disjoint from the integer beat keys, so a
+        # keyed @N campaign step targets publishes without also eating
+        # an unrelated detector/poll read)
         self._transport_down_since: float | None = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -246,6 +250,14 @@ class ClusterMonitor:
             {"pid": self.pid, "beat": beat, "step": self.step}
         )
         try:
+            # kv.partition: the publish is DROPPED (never reaches the
+            # store) — unlike heartbeat_drop it counts as transport
+            # loss, so a fully partitioned non-coordinator walks the
+            # same verdict path a dead coordinator produces
+            if faults.fire("kv.partition", key=beat):
+                raise faults.InjectedFault(
+                    "injected fault at 'kv.partition' (publish dropped)"
+                )
             self.kv.set(HEARTBEAT_PREFIX + str(self.pid), payload)
         except Exception as e:  # noqa: BLE001 — dead coordinator
             if self._transport_down_since is None:
@@ -265,6 +277,15 @@ class ClusterMonitor:
         )
         return True
 
+    def _next_read_key(self) -> str:
+        """The kv.partition key for one KV *read* — a string
+        ("read:N") so it can never alias onto the integer beat keys a
+        campaign's ``at: N`` step targets; probability clauses still
+        hash every read distinctly."""
+        key = f"read:{self._kv_reads}"
+        self._kv_reads += 1
+        return key
+
     # --------------------------------------------------- detect side
 
     def detect_once(self, now: float | None = None) -> tuple[int, ...]:
@@ -275,10 +296,18 @@ class ClusterMonitor:
         alive)."""
         from keystone_tpu.observe import metrics
 
+        from keystone_tpu.resilience import faults
+
         now = self.clock() if now is None else now
         if self._lost is not None:
             return self._lost
-        beats = self.kv.dir(HEARTBEAT_PREFIX)
+        # a partitioned detector read looks exactly like a transport
+        # failure (dir() returning None) — the kv.partition drill
+        beats = (
+            None
+            if faults.fire("kv.partition", self._next_read_key())
+            else self.kv.dir(HEARTBEAT_PREFIX)
+        )
         if beats is None:
             # transport failure on the detector itself — count it like
             # a publish failure; host 0 owns the coordinator, so this
@@ -320,9 +349,15 @@ class ClusterMonitor:
 
     def poll_lost_key(self, now: float | None = None) -> None:
         """Non-detector hosts: pick up host 0's published verdict."""
+        from keystone_tpu.resilience import faults
+
         if self._lost is not None:
             return
-        raw = self.kv.get(LOST_KEY)
+        raw = (
+            None
+            if faults.fire("kv.partition", self._next_read_key())
+            else self.kv.get(LOST_KEY)
+        )
         if not raw:
             return
         try:

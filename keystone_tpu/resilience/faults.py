@@ -106,7 +106,71 @@ SITES: dict[str, str] = {
     "and cycle and collector_scrape_fail increments; the collector must "
     "never crash or tear a segment (observe/collector.py; key = scrape "
     "attempt index)",
+    "ckpt.disk_full": "raise ENOSPC (disk full) at the keyed artifact "
+    "write — inside core/serialization.atomic_write (the temp file is "
+    "discarded, the committed artifact is never touched) and the orbax "
+    "train-save bracket (core/checkpoint.py, where the train loop "
+    "degrades loudly with a ckpt_save_failed event and keeps the "
+    "previous checkpoint); key = save step at checkpoint saves, "
+    "artifact file name inside atomic_write — disjoint domains, so a "
+    "keyed @step campaign never aliases onto an unrelated write",
+    "kv.partition": "drop a coordination-service KV publish/read in the "
+    "cluster membership monitor — a network partition without a "
+    "network: a partitioned publisher counts it as transport loss and "
+    "a fully partitioned non-coordinator concludes host 0 is gone "
+    "(resilience/cluster.py; key = beat index for publishes, "
+    "'read:N' counter for reads — disjoint domains, so a keyed "
+    "@beat step never also eats a detector/poll read)",
 }
+
+
+#: the natural key each site is checked under — declared structurally
+#: (not parsed out of the description prose) because ``faults --list
+#: --json`` is a published contract campaign specs build against.
+#: ``None`` = per-site invocation counter (deterministic for serial
+#: call sites). A site registered in :data:`SITES` without an entry
+#: here fails the registry-consistency test.
+SITE_KEYS: dict[str, str | None] = {
+    "tar.read": None,
+    "idx.read": None,
+    "batch.nan": None,
+    "accel.fit": None,
+    "ckpt.save": None,
+    "ckpt.restore": None,
+    "ckpt.disk_full": "save step (checkpoint saves) / artifact file "
+    "name (atomic_write)",
+    "train.nan": "step index",
+    "train.preempt": "step index",
+    "train.sigterm": "step index",
+    "cluster.heartbeat_drop": "beat index",
+    "cluster.host_kill": "step index",
+    "kv.partition": "beat index (publishes) / 'read:N' counter (reads)",
+    "serve.drop": "request id",
+    "serve.slow_request": "request id",
+    "refit.corrupt_chunk": "chunk file name",
+    "refit.state_digest": "state path",
+    "serve.swap_fail": "swap index",
+    "fleet.replica_kill": "router request id",
+    "fleet.slow_replica": "router request id",
+    "fleet.conn_reset": "router request id",
+    "tune.bad_knob": "evaluation index",
+    "collector.scrape_fail": "scrape attempt index",
+}
+
+
+def site_catalog() -> list[dict]:
+    """Machine-readable registry rows: name, description, and the
+    natural key the site is checked under (:data:`SITE_KEYS`; None =
+    per-site invocation counter). The ``faults --list --json`` body —
+    what campaign specs (``resilience/chaos.py``) validate against."""
+    return [
+        {
+            "name": site,
+            "description": SITES[site],
+            "key": SITE_KEYS.get(site),
+        }
+        for site in sorted(SITES)
+    ]
 
 
 class InjectedFault(IOError):
@@ -295,6 +359,23 @@ def maybe_raise(
         )
 
 
+def maybe_disk_full(key: Any | None = None, note: str = "") -> None:
+    """Raise an :class:`InjectedFault` carrying ``errno.ENOSPC`` when
+    the ``ckpt.disk_full`` site is scheduled — the shape a full disk
+    actually produces, so classifiers that key off errno (the retry
+    policy deliberately treats ENOSPC as non-transient: a full disk
+    does not heal on a 100 ms backoff) see the real thing."""
+    if fire("ckpt.disk_full", key):
+        import errno
+
+        raise InjectedFault(
+            errno.ENOSPC,
+            "No space left on device (injected fault at 'ckpt.disk_full'"
+            + (f": {note}" if note else "")
+            + ")",
+        )
+
+
 def maybe_drop_accelerator(site: str = "accel.fit", key: Any | None = None) -> None:
     if fire(site, key):
         raise AcceleratorDrop(site)
@@ -334,14 +415,22 @@ def main(argv: list[str] | None = None) -> None:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
         raise SystemExit(
-            "usage: python -m keystone_tpu faults --list\n"
+            "usage: python -m keystone_tpu faults --list [--json]\n"
             "       python -m keystone_tpu faults --validate SPEC\n"
             "spec grammar: site:p:seed[:max] | site:@k:seed  "
-            "(comma-separated; see KEYSTONE_FAULTS)"
+            "(comma-separated; see KEYSTONE_FAULTS)\n"
+            "--list --json prints the machine-readable site registry "
+            "(name, description, natural key) that chaos campaign "
+            "specs validate against"
         )
     if argv[0] == "--list":
-        width = max(len(s) for s in SITES)
         try:
+            if "--json" in argv:
+                import json
+
+                print(json.dumps({"sites": site_catalog()}, indent=1))
+                return
+            width = max(len(s) for s in SITES)
             for site in sorted(SITES):
                 print(f"{site:<{width}}  {SITES[site]}")
         except BrokenPipeError:  # | head closed the pipe — fine

@@ -19,6 +19,7 @@ event sink is active) and a ``retries{label=...}`` counter.
 from __future__ import annotations
 
 import dataclasses
+import errno
 import tarfile
 import time
 from typing import Any, Callable
@@ -56,6 +57,15 @@ def is_transient(exc: BaseException) -> bool:
             IsADirectoryError,
         ),
     ):
+        return False
+    if isinstance(exc, OSError) and exc.errno in (
+        errno.ENOSPC,
+        errno.EDQUOT,
+    ):
+        # a full disk / blown quota does not heal on a 100 ms backoff —
+        # retrying just burns the deadline in front of the one error
+        # message the operator needs; callers with a real degrade path
+        # (the train loop's periodic save) handle it explicitly
         return False
     if isinstance(exc, (OSError, EOFError)):
         return True
@@ -123,6 +133,16 @@ class RetryPolicy:
                 last = e
                 attempts_made = attempt + 1
                 delay = self.delay_s(attempt)
+                # an explicit server back-off wins over our schedule: a
+                # transient error carrying ``retry_after_s`` (a shed 503
+                # with a Retry-After header, surfaced by the fleet
+                # transport) stretches the delay to at least that — the
+                # whole point of the header is that N clients retrying
+                # on their own eager schedules re-stampede the very
+                # overload that shed them
+                ra = getattr(e, "retry_after_s", None)
+                if isinstance(ra, (int, float)) and ra > delay:
+                    delay = float(ra)
                 elapsed = self.monotonic() - start
                 deadline_hit = (
                     self.deadline_s is not None

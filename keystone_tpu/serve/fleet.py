@@ -306,7 +306,12 @@ def http_transport(
     """The default dispatch: one HTTP request to the replica, JSON in
     and out. Connection-level failures raise OSError (the failover
     classifier's bread and butter); an unparseable body is a replica
-    failure too, surfaced as :class:`ReplicaUnavailable`."""
+    failure too, surfaced as :class:`ReplicaUnavailable`. A shed 503's
+    ``Retry-After`` header lands in the payload as ``retry_after_s`` so
+    the failover policy can honor the replica's explicit back-off
+    (injected test transports emulate it by putting the key in the
+    payload directly; 4xx answers pass through to the client untouched,
+    so a 429's header would have nobody to honor it)."""
     conn = http.client.HTTPConnection(
         replica.host, replica.port, timeout=timeout
     )
@@ -324,6 +329,13 @@ def http_transport(
             raise ReplicaUnavailable(
                 f"replica {replica.rid} answered unparseable JSON"
             ) from e
+        if resp.status == 503:
+            ra = resp.getheader("Retry-After")
+            if ra is not None:
+                try:
+                    parsed.setdefault("retry_after_s", float(ra))
+                except (ValueError, AttributeError):
+                    pass
         return resp.status, parsed
     finally:
         conn.close()
@@ -742,10 +754,19 @@ class Fleet:
         if status >= 500:
             fails[0] += 1
             r.breaker.record_failure()
-            raise ReplicaUnavailable(
+            err = ReplicaUnavailable(
                 f"replica {r.rid} answered {status}: "
                 f"{payload.get('error', '')!r}"
             )
+            ra = payload.get("retry_after_s")
+            if isinstance(ra, (int, float)) and ra > 0:
+                # an admission-shed 503's explicit back-off: the retry
+                # policy waits AT LEAST this long before the next
+                # failover attempt (the thundering-herd fix — N eager
+                # retries against an overloaded tier re-create the
+                # overload that shed them)
+                err.retry_after_s = float(ra)
+            raise err
         r.breaker.record_success()
         if status >= 400:
             raise ReplicaHTTPError(status, payload)

@@ -441,7 +441,14 @@ class ServeApp:
 
 
 class OverloadShed(RuntimeError):
-    """Admission refused this request (the 503 path)."""
+    """Admission refused this request (the 503 path). Carries
+    ``retry_after_s`` so the HTTP surface can emit a Retry-After header
+    — an upstream failover policy backs off by AT LEAST that much
+    instead of re-stampeding the overload on its own schedule."""
+
+    def __init__(self, msg: str, retry_after_s: int = 1):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
 
 
 def write_metrics_response(handler) -> None:
@@ -471,14 +478,26 @@ def _handler_for(app: ServeApp):
         def log_message(self, fmt, *args):  # noqa: D102
             pass
 
-        def _send(self, code: int, payload: dict) -> None:
-            self._send_text(code, json.dumps(payload), "application/json")
+        def _send(
+            self, code: int, payload: dict, headers: dict | None = None
+        ) -> None:
+            self._send_text(
+                code, json.dumps(payload), "application/json", headers
+            )
 
-        def _send_text(self, code: int, text: str, content_type: str) -> None:
+        def _send_text(
+            self,
+            code: int,
+            text: str,
+            content_type: str,
+            headers: dict | None = None,
+        ) -> None:
             body = text.encode()
             self.send_response(code)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, str(v))
             self.end_headers()
             self.wfile.write(body)
 
@@ -537,7 +556,11 @@ def _handler_for(app: ServeApp):
                 else:
                     return self._send(404, {"error": f"unknown path {self.path}"})
             except OverloadShed as e:
-                return self._send(503, {"error": str(e)})
+                return self._send(
+                    503,
+                    {"error": str(e)},
+                    headers={"Retry-After": str(e.retry_after_s)},
+                )
             except (ValueError, TypeError) as e:
                 return self._send(400, {"error": str(e)})
             except TimeoutError as e:

@@ -1,105 +1,32 @@
-"""A stdlib-only stand-in replica for the fleet process tests.
+"""Thin shim over the packaged stub replica.
 
-Implements exactly the slice of the ``serve`` HTTP contract the fleet
-router depends on — ``POST /predict`` (echo rows doubled), ``GET
-/healthz`` with the ``draining`` flag, and the SIGTERM drain-then-exit-0
-shutdown — with none of the jax/model boot cost, so rolling-restart and
-failover drills that need REAL processes (SIGTERM, SIGKILL, relaunch,
-port rebind) run in seconds. The full-stack mnist drill in
-``test_fleet.py`` covers the real server; this worker covers the
-process choreography cheaply.
+The stdlib-only stand-in replica used by the fleet/collector process
+drills now ships in the package (``keystone_tpu/resilience/
+chaos_stub.py``) so chaos game days can spawn it outside the tests —
+this shim keeps the tests' spawn path (``python tests/
+fleet_replica_worker.py --port N``) working while there is exactly ONE
+copy of the replica contract: a change to the stub (a new /healthz
+field, a drain-timing tweak) reaches the fleet tests, the collector
+drills, and the chaos campaigns together instead of drifting apart.
 
-Env knobs: ``STUB_SLOW_MS`` delays every /predict (tail-latency rig),
-``STUB_DRAIN_S`` holds the process in its draining state before exit
-(so a poller can observe ``draining: true``), ``STUB_FAIL_PREDICT=1``
-answers 500 on /predict (breaker rig).
+Loaded by FILE PATH via runpy, not imported as a package module: the
+stub's whole point is a replica that boots in ~0.2 s with no jax, and
+``import keystone_tpu`` would drag the package __init__ (and jax) into
+every spawn.
+
+Env knobs (see the packaged module): ``STUB_SLOW_MS``, ``STUB_DRAIN_S``,
+``STUB_QUEUE_DEPTH``, ``STUB_P95_MS``, ``STUB_FAIL_PREDICT``.
 """
 
-import json
 import os
-import signal
-import sys
-import threading
-import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+import runpy
 
-STATE = {"draining": False, "requests": 0}
-
-
-class Handler(BaseHTTPRequestHandler):
-    def log_message(self, fmt, *args):  # noqa: D102 — keep test logs clean
-        pass
-
-    def _send(self, code, payload):
-        body = json.dumps(payload).encode()
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
-
-    def do_GET(self):  # noqa: N802 — stdlib API
-        if self.path == "/healthz":
-            return self._send(
-                200,
-                {
-                    "status": "draining" if STATE["draining"] else "ok",
-                    "draining": STATE["draining"],
-                    "queue_depth": float(os.environ.get("STUB_QUEUE_DEPTH", 0)),
-                    "queue_p95_ms": float(os.environ.get("STUB_P95_MS", 1.0)),
-                    "requests": STATE["requests"],
-                    "pid": os.getpid(),
-                },
-            )
-        return self._send(404, {"error": self.path})
-
-    def do_POST(self):  # noqa: N802 — stdlib API
-        n = int(self.headers.get("Content-Length") or 0)
-        body = json.loads(self.rfile.read(n) or b"{}")
-        if self.path != "/predict":
-            return self._send(404, {"error": self.path})
-        if os.environ.get("STUB_FAIL_PREDICT") == "1":
-            return self._send(500, {"error": "injected stub failure"})
-        slow_ms = float(os.environ.get("STUB_SLOW_MS", 0) or 0)
-        if slow_ms:
-            time.sleep(slow_ms / 1e3)
-        STATE["requests"] += 1
-        rows = body.get("rows") or []
-        return self._send(
-            200,
-            {
-                "predictions": [[2.0 * v for v in row] for row in rows],
-                "pid": os.getpid(),
-                "trace": self.headers.get("X-Keystone-Trace"),
-            },
-        )
-
-
-def main():
-    port = 0
-    if "--port" in sys.argv:
-        port = int(sys.argv[sys.argv.index("--port") + 1])
-    httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
-
-    def term(signum, frame):
-        # the PR-7 drain contract in miniature: flag draining (visible
-        # in /healthz immediately), keep answering briefly so pollers
-        # can see it, then exit 0
-        STATE["draining"] = True
-
-        def stop():
-            time.sleep(float(os.environ.get("STUB_DRAIN_S", 0.2)))
-            httpd.shutdown()
-
-        threading.Thread(target=stop, daemon=True).start()
-
-    signal.signal(signal.SIGTERM, term)
-    print(f"stub replica on {httpd.server_address[1]}", flush=True)
-    try:
-        httpd.serve_forever(poll_interval=0.05)
-    finally:
-        httpd.server_close()
-
+_STUB = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "keystone_tpu",
+    "resilience",
+    "chaos_stub.py",
+)
 
 if __name__ == "__main__":
-    main()
+    runpy.run_path(_STUB, run_name="__main__")
